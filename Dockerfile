@@ -1,0 +1,18 @@
+# Serving image (reference: /root/reference/Dockerfile — python:3.11-slim +
+# uvicorn). The TPU build ships the whole package and runs the aiohttp
+# entrypoint; on TPU VMs use a jax[tpu]-enabled base instead.
+FROM python:3.11-slim
+
+ENV PYTHONDONTWRITEBYTECODE=1 \
+    PYTHONUNBUFFERED=1
+
+WORKDIR /app
+
+COPY requirements.txt .
+RUN pip install --no-cache-dir -r requirements.txt
+
+COPY ai_agent_kubectl_tpu/ ai_agent_kubectl_tpu/
+
+EXPOSE 8000
+
+CMD ["python", "-m", "ai_agent_kubectl_tpu.server"]

@@ -1,0 +1,211 @@
+"""Typed environment-variable configuration.
+
+Rebuilds the reference's config layer (app.py:23-36, .env-sample:1-25) as a
+frozen dataclass parsed once at startup. Every reference knob is preserved
+verbatim (``API_AUTH_KEY``, ``CACHE_MAXSIZE``, ``CACHE_TTL``, ``LLM_TIMEOUT``,
+``EXECUTION_TIMEOUT``, ``RATE_LIMIT``, ``LOG_LEVEL``, ``PORT``, ``HOST``).
+The reference's ``OPENAI_*`` knobs are replaced by local-engine knobs
+(``MODEL_NAME``, ``MODEL_PATH``, mesh/dtype/sequence/batch settings); an
+OpenAI-compatible client engine is still available for parity with the
+reference's remote path (``ENGINE=openai``, honouring ``OPENAI_BASE_URL``).
+
+A minimal ``.env`` loader replaces python-dotenv (reference app.py:24): lines
+of ``KEY=VALUE``, ``#`` comments, optional ``export`` prefix, single/double
+quote stripping. Existing process env always wins (dotenv semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field, fields
+from pathlib import Path
+from typing import Optional, Tuple
+
+
+def load_env_file(path: str | os.PathLike = ".env", *, override: bool = False) -> dict:
+    """Parse a .env file into os.environ. Returns the parsed mapping.
+
+    Missing file is not an error (matches dotenv behaviour the reference
+    relies on at app.py:24).
+    """
+    parsed: dict[str, str] = {}
+    p = Path(path)
+    if not p.is_file():
+        return parsed
+    for raw in p.read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("export "):
+            line = line[len("export "):].lstrip()
+        if "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if len(value) >= 2 and value[0] == value[-1] and value[0] in ("'", '"'):
+            value = value[1:-1]
+        else:
+            # Strip trailing inline comment on unquoted values.
+            value = value.split(" #", 1)[0].rstrip()
+        if not key:
+            continue
+        parsed[key] = value
+        if override or key not in os.environ:
+            os.environ[key] = value
+    return parsed
+
+
+_RATE_RE = re.compile(
+    r"^\s*(\d+)\s*(?:/|\s+per\s+)\s*(\d*)\s*(second|minute|hour|day)s?\s*$",
+    re.IGNORECASE,
+)
+
+_PERIOD_SECONDS = {"second": 1, "minute": 60, "hour": 3600, "day": 86400}
+
+
+def parse_rate_limit(spec: str) -> Tuple[int, float]:
+    """Parse a slowapi-style rate string ("10/minute", "5 per 30 second")
+    into (count, window_seconds). Reference default: "10/minute"
+    (app.py:32)."""
+    m = _RATE_RE.match(spec)
+    if not m:
+        raise ValueError(f"Invalid rate limit spec: {spec!r}")
+    count = int(m.group(1))
+    multiple = int(m.group(2)) if m.group(2) else 1
+    window = multiple * _PERIOD_SECONDS[m.group(3).lower()]
+    return count, float(window)
+
+
+def _env_str(name: str, default: Optional[str]) -> Optional[str]:
+    v = os.getenv(name)
+    return v if v not in (None, "") else default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.getenv(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.getenv(name)
+    return float(v) if v not in (None, "") else default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.getenv(name)
+    if v in (None, ""):
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass(frozen=True)
+class ServiceConfig:
+    """Everything the serving layer needs; reference knobs preserved."""
+
+    # --- reference knobs, verbatim (app.py:27-33, 394-395) ---
+    api_auth_key: Optional[str] = None      # API_AUTH_KEY; auth disabled if unset
+    cache_maxsize: int = 100                # CACHE_MAXSIZE
+    cache_ttl: float = 300.0                # CACHE_TTL seconds
+    llm_timeout: float = 60.0               # LLM_TIMEOUT seconds
+    execution_timeout: float = 30.0         # EXECUTION_TIMEOUT seconds
+    rate_limit: str = "10/minute"           # RATE_LIMIT
+    log_level: str = "INFO"                 # LOG_LEVEL
+    host: str = "0.0.0.0"                   # HOST
+    port: int = 8000                        # PORT
+    # Honour X-Forwarded-For for rate-limit keying ONLY behind a trusted
+    # proxy — a direct client could otherwise mint a fresh quota per request.
+    trust_proxy_headers: bool = False       # TRUST_PROXY_HEADERS
+
+    # --- engine selection (replaces OPENAI_* block, app.py:34-36) ---
+    engine: str = "fake"                    # ENGINE: jax | fake | openai
+    model_name: str = "toy-8m"              # MODEL_NAME (registry key)
+    model_path: Optional[str] = None        # MODEL_PATH (checkpoint dir)
+    tokenizer_path: Optional[str] = None    # TOKENIZER_PATH
+
+    # --- engine knobs ---
+    dtype: str = "bfloat16"                 # DTYPE
+    max_seq_len: int = 1024                 # MAX_SEQ_LEN
+    max_new_tokens: int = 128               # MAX_NEW_TOKENS
+    decode_batch_size: int = 8              # DECODE_BATCH_SIZE (continuous batching slots)
+    prefill_buckets: str = "64,128,256,512,1024"  # PREFILL_BUCKETS (padded prefill shapes)
+    temperature: float = 0.0                # TEMPERATURE (0 == greedy, matches app.py:109)
+    kv_page_size: int = 16                  # KV_PAGE_SIZE (paged attention)
+    hbm_prefix_cache: bool = True           # HBM_PREFIX_CACHE (system-prompt prefix KV)
+
+    # --- parallelism knobs ---
+    mesh_shape: str = ""                    # MESH_SHAPE e.g. "data:1,model:8"
+    dcn_mesh_shape: str = ""                # DCN_MESH_SHAPE for multi-slice
+    distributed_init: bool = False          # DISTRIBUTED_INIT (jax.distributed.initialize)
+    coordinator_address: Optional[str] = None   # COORDINATOR_ADDRESS
+    num_processes: int = 1                  # NUM_PROCESSES
+    process_id: int = 0                     # PROCESS_ID
+
+    # --- openai-compat engine (reference parity path, app.py:34-36) ---
+    openai_api_key: Optional[str] = None    # OPENAI_API_KEY
+    openai_model: str = "gpt-3.5-turbo"     # OPENAI_MODEL
+    openai_base_url: Optional[str] = None   # OPENAI_BASE_URL
+
+    # derived
+    rate_limit_count: int = field(init=False, default=10)
+    rate_limit_window: float = field(init=False, default=60.0)
+
+    def __post_init__(self):
+        count, window = parse_rate_limit(self.rate_limit)
+        object.__setattr__(self, "rate_limit_count", count)
+        object.__setattr__(self, "rate_limit_window", window)
+
+    @property
+    def auth_enabled(self) -> bool:
+        return bool(self.api_auth_key)
+
+    @property
+    def prefill_bucket_list(self) -> Tuple[int, ...]:
+        return tuple(sorted(int(b) for b in self.prefill_buckets.split(",") if b.strip()))
+
+    @classmethod
+    def from_env(cls, env_file: str | os.PathLike | None = ".env") -> "ServiceConfig":
+        if env_file is not None:
+            load_env_file(env_file)
+        return cls(
+            api_auth_key=_env_str("API_AUTH_KEY", None),
+            cache_maxsize=_env_int("CACHE_MAXSIZE", 100),
+            cache_ttl=_env_float("CACHE_TTL", 300.0),
+            llm_timeout=_env_float("LLM_TIMEOUT", 60.0),
+            execution_timeout=_env_float("EXECUTION_TIMEOUT", 30.0),
+            rate_limit=_env_str("RATE_LIMIT", "10/minute"),
+            log_level=(_env_str("LOG_LEVEL", "INFO") or "INFO").upper(),
+            host=_env_str("HOST", "0.0.0.0"),
+            port=_env_int("PORT", 8000),
+            trust_proxy_headers=_env_bool("TRUST_PROXY_HEADERS", False),
+            engine=(_env_str("ENGINE", "fake") or "fake").lower(),
+            model_name=_env_str("MODEL_NAME", "toy-8m"),
+            model_path=_env_str("MODEL_PATH", None),
+            tokenizer_path=_env_str("TOKENIZER_PATH", None),
+            dtype=_env_str("DTYPE", "bfloat16"),
+            max_seq_len=_env_int("MAX_SEQ_LEN", 1024),
+            max_new_tokens=_env_int("MAX_NEW_TOKENS", 128),
+            decode_batch_size=_env_int("DECODE_BATCH_SIZE", 8),
+            prefill_buckets=_env_str("PREFILL_BUCKETS", "64,128,256,512,1024"),
+            temperature=_env_float("TEMPERATURE", 0.0),
+            kv_page_size=_env_int("KV_PAGE_SIZE", 16),
+            hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
+            mesh_shape=_env_str("MESH_SHAPE", "") or "",
+            dcn_mesh_shape=_env_str("DCN_MESH_SHAPE", "") or "",
+            distributed_init=_env_bool("DISTRIBUTED_INIT", False),
+            coordinator_address=_env_str("COORDINATOR_ADDRESS", None),
+            num_processes=_env_int("NUM_PROCESSES", 1),
+            process_id=_env_int("PROCESS_ID", 0),
+            openai_api_key=_env_str("OPENAI_API_KEY", None),
+            openai_model=_env_str("OPENAI_MODEL", "gpt-3.5-turbo"),
+            openai_base_url=_env_str("OPENAI_BASE_URL", None),
+        )
+
+    def describe(self) -> dict:
+        """Loggable, secret-free view of the config."""
+        d = {f.name: getattr(self, f.name) for f in fields(self) if f.init}
+        for secret in ("api_auth_key", "openai_api_key"):
+            if d.get(secret):
+                d[secret] = "***"
+        return d

@@ -1,0 +1,14 @@
+"""Inference engine layer — the seam where the reference called OpenAI.
+
+The reference's entire "model" was one awaited remote call
+(app.py:117,184). Here that seam is the ``Engine`` protocol
+(``protocol.py``), with implementations:
+
+- ``fake.FakeEngine``     — deterministic rule-based engine for tests
+- ``openai_compat.OpenAICompatEngine`` — httpx client for the reference's
+  remote path (BASELINE config 1)
+- ``jax_engine.JaxEngine`` — the TPU-native local engine: tokenizer →
+  batcher → jit prefill/decode → Pallas kernels → sharded weights/KV
+"""
+
+from .protocol import Engine, EngineResult, EngineUnavailable, GenerationTimeout  # noqa: F401

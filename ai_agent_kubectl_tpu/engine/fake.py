@@ -1,0 +1,113 @@
+"""FakeEngine — deterministic engine for tests (SURVEY.md §4, boundary 1).
+
+Maps a handful of natural-language patterns to canned kubectl commands and
+supports scripted responses/latency/failures so API tests can exercise every
+status code without a TPU or network.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+from typing import AsyncIterator, Dict, List, Optional
+
+from .protocol import EngineResult, EngineUnavailable, GenerationTimeout
+
+_RULES = [
+    (re.compile(r"\b(list|get|show)\b.*\bpods?\b", re.I), "kubectl get pods"),
+    (re.compile(r"\b(list|get|show)\b.*\bnodes?\b", re.I), "kubectl get nodes"),
+    (re.compile(r"\b(list|get|show)\b.*\b(deployments?|deploys?)\b", re.I),
+     "kubectl get deployments"),
+    (re.compile(r"\b(list|get|show)\b.*\bservices?\b", re.I), "kubectl get services"),
+    (re.compile(r"\b(list|get|show)\b.*\bnamespaces?\b", re.I), "kubectl get namespaces"),
+    (re.compile(r"\blogs?\b.*?(?:\bof\b|\bfor\b|\bfrom\b)\s+(\S+)", re.I),
+     "kubectl logs {0}"),
+    (re.compile(r"\bdescribe\b.*\bpod\b\s+(\S+)", re.I), "kubectl describe pod {0}"),
+    (re.compile(r"\bdelete\b.*\bpod\b\s+(\S+)", re.I), "kubectl delete pod {0}"),
+    (re.compile(r"\bscale\b.*\bdeployment\b\s+(\S+).*?\b(\d+)\b", re.I),
+     "kubectl scale deployment {0} --replicas={1}"),
+]
+
+
+class FakeEngine:
+    """Deterministic pattern-matching engine.
+
+    Test hooks:
+    - ``scripted``: queue of exact responses returned before rule matching
+      (use to inject unsafe output, fences, etc.)
+    - ``delay``: per-call artificial latency (exercises the 504 path)
+    - ``fail_with``: exception raised on next generate (exercises 500/503)
+    """
+
+    name = "fake"
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+        self.scripted: List[str] = []
+        self.fail_with: Optional[BaseException] = None
+        self.calls = 0
+        self._ready = False
+
+    @property
+    def ready(self) -> bool:
+        return self._ready
+
+    async def start(self) -> None:
+        self._ready = True
+
+    async def stop(self) -> None:
+        self._ready = False
+
+    def _answer(self, prompt: str) -> str:
+        # The service renders prompts as "...User Request: <query>\nKubectl Command:"
+        m = re.search(r"User Request:\s*(.*?)\s*(?:\nKubectl Command:|\Z)", prompt, re.S)
+        query = m.group(1) if m else prompt
+        for pattern, template in _RULES:
+            hit = pattern.search(query)
+            if hit:
+                return template.format(*hit.groups())
+        return "kubectl get all"
+
+    async def generate(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> EngineResult:
+        if not self._ready:
+            raise EngineUnavailable("FakeEngine not started")
+        self.calls += 1
+        if self.fail_with is not None:
+            exc, self.fail_with = self.fail_with, None
+            raise exc
+        if self.delay:
+            if timeout is not None and self.delay >= timeout:
+                await asyncio.sleep(timeout)
+                raise GenerationTimeout(f"generation exceeded {timeout}s")
+            await asyncio.sleep(self.delay)
+        text = self.scripted.pop(0) if self.scripted else self._answer(prompt)
+        n_completion = max(len(text.split()), 1)
+        return EngineResult(
+            text=text,
+            prompt_tokens=len(prompt.split()),
+            completion_tokens=n_completion,
+            decode_ms=self.delay * 1000.0,
+            ttft_ms=self.delay * 1000.0,
+            engine=self.name,
+        )
+
+    async def generate_stream(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> AsyncIterator[str]:
+        result = await self.generate(
+            prompt, max_tokens=max_tokens, temperature=temperature, timeout=timeout
+        )
+        for i, word in enumerate(result.text.split(" ")):
+            yield word if i == 0 else " " + word

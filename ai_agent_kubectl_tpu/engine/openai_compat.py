@@ -1,0 +1,103 @@
+"""OpenAI-compatible remote engine (reference parity path).
+
+Re-implements the reference's LangChain ``ChatOpenAI`` call
+(app.py:106-122, 183-186) as a direct httpx ChatCompletions client, for
+BASELINE config 1 and for pointing at any local OpenAI-compatible stub
+server (the reference's ``OPENAI_BASE_URL`` escape hatch, app.py:114-115).
+
+temperature=0 default matches app.py:109.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import AsyncIterator, Optional
+
+import httpx
+
+from .protocol import EngineResult, EngineUnavailable, GenerationTimeout
+
+
+class OpenAICompatEngine:
+    name = "openai"
+
+    def __init__(
+        self,
+        api_key: Optional[str],
+        model: str = "gpt-3.5-turbo",
+        base_url: Optional[str] = None,
+        timeout: float = 60.0,
+    ):
+        self.api_key = api_key
+        self.model = model
+        self.base_url = (base_url or "https://api.openai.com/v1").rstrip("/")
+        self.timeout = timeout
+        self._client: Optional[httpx.AsyncClient] = None
+
+    @property
+    def ready(self) -> bool:
+        return self._client is not None and bool(self.api_key)
+
+    async def start(self) -> None:
+        headers = {}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        self._client = httpx.AsyncClient(
+            base_url=self.base_url, headers=headers, timeout=self.timeout
+        )
+
+    async def stop(self) -> None:
+        if self._client is not None:
+            await self._client.aclose()
+            self._client = None
+
+    async def generate(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> EngineResult:
+        if self._client is None or not self.api_key:
+            raise EngineUnavailable("OpenAI engine not initialized (missing key?)")
+        t0 = time.monotonic()
+        try:
+            resp = await self._client.post(
+                "/chat/completions",
+                json={
+                    "model": self.model,
+                    "messages": [{"role": "user", "content": prompt}],
+                    "temperature": temperature,
+                    "max_tokens": max_tokens,
+                },
+                timeout=timeout or self.timeout,
+            )
+        except httpx.TimeoutException as e:
+            raise GenerationTimeout(str(e)) from e
+        resp.raise_for_status()
+        data = resp.json()
+        text = data["choices"][0]["message"]["content"]
+        usage = data.get("usage", {})
+        elapsed_ms = (time.monotonic() - t0) * 1000.0
+        return EngineResult(
+            text=text,
+            prompt_tokens=usage.get("prompt_tokens", 0),
+            completion_tokens=usage.get("completion_tokens", 0),
+            decode_ms=elapsed_ms,
+            ttft_ms=elapsed_ms,
+            engine=self.name,
+        )
+
+    async def generate_stream(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> AsyncIterator[str]:
+        result = await self.generate(
+            prompt, max_tokens=max_tokens, temperature=temperature, timeout=timeout
+        )
+        yield result.text

@@ -1,0 +1,89 @@
+"""The Engine protocol — the LLM-integration seam (reference app.py:106-122).
+
+Everything above this seam (API, middleware, service, cache, exec) is
+engine-agnostic; everything below it is a particular inference backend.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Optional, Protocol, runtime_checkable
+
+
+class EngineUnavailable(RuntimeError):
+    """Engine not initialized / degraded mode → HTTP 503
+    (reference app.py:179-180)."""
+
+
+class GenerationTimeout(TimeoutError):
+    """Generation exceeded the configured timeout → HTTP 504
+    (reference app.py:189-191)."""
+
+
+@dataclass
+class EngineResult:
+    """One completed generation with phase timings."""
+
+    text: str
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    queue_ms: float = 0.0
+    prefill_ms: float = 0.0
+    decode_ms: float = 0.0
+    ttft_ms: float = 0.0
+    prefix_cache_hit: bool = False
+    finish_reason: str = "stop"  # stop | length | abort
+    engine: str = ""
+
+    @property
+    def tokens_per_sec(self) -> float:
+        if self.decode_ms <= 0 or self.completion_tokens <= 0:
+            return 0.0
+        return self.completion_tokens / (self.decode_ms / 1000.0)
+
+
+@runtime_checkable
+class Engine(Protocol):
+    """Async generation interface behind the service layer.
+
+    ``generate`` returns the raw model text; output parsing/safety
+    validation stay in the service layer (the reference put them inside the
+    LangChain chain, app.py:118 — keeping them outside the engine lets every
+    backend share one validator).
+    """
+
+    name: str
+
+    @property
+    def ready(self) -> bool:  # readiness-gated /health (SURVEY.md §3.3)
+        ...
+
+    async def start(self) -> None:
+        """Load weights, compile, warm up. Must be called before generate."""
+        ...
+
+    async def stop(self) -> None:
+        """Graceful drain/shutdown."""
+        ...
+
+    async def generate(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> EngineResult:
+        ...
+
+    def generate_stream(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> AsyncIterator[str]:
+        """Yield decoded text increments (for the streaming /execute agent
+        loop, BASELINE config 5)."""
+        ...

@@ -1,0 +1,26 @@
+"""Logging configuration matching the reference's format (app.py:38-47)."""
+
+from __future__ import annotations
+
+import logging
+
+
+def setup_logging(level: str = "INFO") -> logging.Logger:
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s - %(name)s - %(levelname)s - %(message)s",
+    )
+    return logging.getLogger("ai_agent_kubectl_tpu")
+
+
+def startup_warnings(cfg) -> None:
+    """Key-presence warnings at startup (reference app.py:42-47)."""
+    logger = logging.getLogger("ai_agent_kubectl_tpu")
+    if not cfg.api_auth_key:
+        logger.warning(
+            "API_AUTH_KEY environment variable not set. API authentication is disabled."
+        )
+    if cfg.engine == "openai" and not cfg.openai_api_key:
+        logger.error(
+            "ENGINE=openai but OPENAI_API_KEY not set; engine will run degraded (503)."
+        )

@@ -1,0 +1,13 @@
+"""Serving front end: HTTP API + cross-cutting middleware + execution layer.
+
+Rebuilds the reference's API/middleware/service/cache/exec layers
+(SURVEY.md §1) on aiohttp, with from-scratch implementations of the
+pieces the reference delegated to third-party packages:
+
+- rate limiting  (slowapi      → ``ratelimit.SlidingWindowLimiter``)
+- TTL caching    (cachetools   → ``cache.TTLCache`` with single-flight)
+- env loading    (dotenv       → ``config.load_env_file``)
+- metrics        (instrumentator → ``metrics`` on prometheus_client)
+"""
+
+from .app import create_app  # noqa: F401

@@ -1,0 +1,26 @@
+"""Server entrypoint: ``python -m ai_agent_kubectl_tpu.server``
+(reference app.py:391-400, Dockerfile:33)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ..config import ServiceConfig
+from ..logging_setup import setup_logging, startup_warnings
+from .app import create_app
+from .factory import build_engine
+
+
+def main() -> None:
+    cfg = ServiceConfig.from_env()
+    logger = setup_logging(cfg.log_level)
+    startup_warnings(cfg)
+    logger.info("Config: %s", cfg.describe())
+    engine = build_engine(cfg)
+    app = create_app(cfg, engine)
+    logger.info("Starting server on %s:%s (engine=%s)", cfg.host, cfg.port, cfg.engine)
+    web.run_app(app, host=cfg.host, port=cfg.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,380 @@
+"""HTTP API layer (reference app.py:130-138, 283-400) on aiohttp.
+
+Endpoints (same contract and status codes as the reference):
+
+- ``POST /kubectl-command`` — NL query → validated kubectl command
+  (app.py:284-346). 200/401/422(unsafe)/429/500/503/504. Pydantic
+  validation errors → 400 (invalid input query). Deliberate choice on quirk
+  B1 (SURVEY.md §2.3): generation and execution remain fully separated — the
+  hardcoded success-metadata stub is replaced by *real* generation-phase
+  metadata, and ``execution_result``/``execution_error`` stay None here.
+- ``POST /execute`` — run a validated kubectl command (app.py:356-389).
+  200/400(unsafe)/401/429/500; execution errors are structured 200s with
+  ``execution_error`` set (B2 fixed in executor.py).
+- ``POST /kubectl-command/stream`` — TPU-native addition: streams generated
+  tokens as SSE for the multi-turn agent loop (BASELINE config 5).
+- ``GET /health`` — readiness-gated (fixes static health, app.py:348-354).
+- ``GET /metrics`` — Prometheus (app.py:136-138).
+
+Cross-cutting (middleware): per-IP sliding-window rate limit → 429 with
+Retry-After; API-key auth via ``X-API-Key`` (app.py:140-151), disabled when
+``API_AUTH_KEY`` unset; HTTP request counters/latency histograms.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from ..config import ServiceConfig
+from ..engine.protocol import Engine, EngineResult, EngineUnavailable, GenerationTimeout
+from ..engine.prompts import render_prompt
+from .cache import CachedSingleFlight
+from .executor import CommandExecutor, build_metadata, utcnow_iso
+from .metrics import Metrics
+from .output_parser import UnsafeCommandError, parse_llm_output
+from .ratelimit import SlidingWindowLimiter
+from .sanitize import sanitize_query
+from .schemas import (
+    CommandResponse,
+    EngineMetadata,
+    ExecuteRequest,
+    ExecutionMetadata,
+    HealthResponse,
+    Query,
+)
+
+logger = logging.getLogger(__name__)
+
+RATE_LIMITED_ROUTES = {"/kubectl-command", "/kubectl-command/stream", "/execute"}
+AUTH_ROUTES = RATE_LIMITED_ROUTES
+
+
+def _client_key(request: web.Request) -> str:
+    """Remote-address key for rate limiting. X-Forwarded-For is honoured
+    only when TRUST_PROXY_HEADERS is set — a direct client could otherwise
+    mint a fresh rate-limit bucket per request by forging the header."""
+    svc: Service = request.app["service"]
+    if svc.cfg.trust_proxy_headers:
+        fwd = request.headers.get("X-Forwarded-For")
+        if fwd:
+            return fwd.split(",")[0].strip()
+    return request.remote or "unknown"
+
+
+def _json_error(status: int, detail: str, headers: Optional[dict] = None) -> web.Response:
+    return web.json_response({"detail": detail}, status=status, headers=headers or {})
+
+
+class Service:
+    """Bundles the app's long-lived components (the reference kept these as
+    module globals, app.py:124-138)."""
+
+    def __init__(self, cfg: ServiceConfig, engine: Engine,
+                 executor: Optional[CommandExecutor] = None,
+                 metrics: Optional[Metrics] = None):
+        self.cfg = cfg
+        self.engine = engine
+        self.executor = executor or CommandExecutor(timeout=cfg.execution_timeout)
+        self.metrics = metrics or Metrics()
+        self.cache: CachedSingleFlight[str, str] = CachedSingleFlight(
+            cfg.cache_maxsize, cfg.cache_ttl
+        )
+        self.limiter = SlidingWindowLimiter(cfg.rate_limit_count, cfg.rate_limit_window)
+
+    async def generate_command(self, sanitized_query: str) -> tuple[str, bool, Optional[EngineResult]]:
+        """Cache-or-generate; returns (command, from_cache, engine_result)."""
+        last_result: list[Optional[EngineResult]] = [None]
+
+        async def supplier() -> str:
+            prompt = render_prompt(sanitized_query)
+            result = await self.engine.generate(
+                prompt,
+                max_tokens=self.cfg.max_new_tokens,
+                temperature=self.cfg.temperature,
+                timeout=self.cfg.llm_timeout,
+            )
+            last_result[0] = result
+            command = parse_llm_output(result.text)
+            logger.info(
+                "Engine generated command for query '%s': %s", sanitized_query, command
+            )
+            return command
+
+        command, from_cache = await self.cache.get_or_create(sanitized_query, supplier)
+        if from_cache:
+            self.metrics.cache_hits.inc()
+        else:
+            self.metrics.cache_misses.inc()
+        return command, from_cache, last_result[0]
+
+
+@web.middleware
+async def observability_middleware(request: web.Request, handler):
+    svc: Service = request.app["service"]
+    start = time.monotonic()
+    path = request.path
+    status = 500
+    try:
+        response = await handler(request)
+        status = response.status
+        return response
+    except web.HTTPException as e:
+        status = e.status
+        raise
+    finally:
+        elapsed = time.monotonic() - start
+        svc.metrics.http_requests.labels(request.method, path, str(status)).inc()
+        svc.metrics.http_latency.labels(request.method, path).observe(elapsed)
+
+
+@web.middleware
+async def ratelimit_middleware(request: web.Request, handler):
+    svc: Service = request.app["service"]
+    if request.path in RATE_LIMITED_ROUTES:
+        allowed, remaining, retry_after = svc.limiter.check(_client_key(request))
+        if not allowed:
+            svc.metrics.rate_limited.inc()
+            return _json_error(
+                429,
+                f"Rate limit exceeded: {svc.cfg.rate_limit}",
+                headers=svc.limiter.headers(remaining, retry_after),
+            )
+    return await handler(request)
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    """X-API-Key auth (reference app.py:140-151); disabled when no key
+    configured."""
+    svc: Service = request.app["service"]
+    if svc.cfg.auth_enabled and request.path in AUTH_ROUTES:
+        key = request.headers.get("X-API-Key")
+        if not key:
+            logger.warning("Missing X-API-Key header.")
+            return _json_error(401, "Missing X-API-Key header")
+        if key != svc.cfg.api_auth_key:
+            logger.warning("Invalid API Key received.")
+            return _json_error(401, "Invalid API Key")
+    return await handler(request)
+
+
+async def handle_kubectl_command(request: web.Request) -> web.Response:
+    """POST /kubectl-command (reference app.py:284-346)."""
+    svc: Service = request.app["service"]
+    start_iso = utcnow_iso()
+    t0 = time.monotonic()
+    try:
+        q = Query.model_validate(await request.json())
+    except (ValidationError, ValueError) as e:
+        return _json_error(400, f"Invalid input query: {e}")
+
+    logger.info("Received query: '%s'", q.query)
+    sanitized_query = sanitize_query(q.query)
+    if len(sanitized_query) < 3:
+        return _json_error(400, "Invalid input query: too short after sanitation")
+
+    try:
+        command, from_cache, engine_result = await svc.generate_command(sanitized_query)
+    except EngineUnavailable as e:
+        return _json_error(503, f"Engine not available: {e}")
+    except (GenerationTimeout, asyncio.TimeoutError):
+        logger.error("Engine timed out after %ss for query: %s", svc.cfg.llm_timeout, sanitized_query)
+        return _json_error(504, "LLM request timed out")
+    except UnsafeCommandError as e:
+        logger.error("Engine generated unsafe command: %s", e)
+        svc.metrics.unsafe_commands.labels("llm").inc()
+        return _json_error(422, f"LLM generated unsafe command: {e}")
+    except Exception as e:
+        logger.exception("Unexpected error processing query '%s'", sanitized_query)
+        return _json_error(500, "Internal server error processing request")
+
+    duration_ms = (time.monotonic() - t0) * 1000.0
+    engine_md = None
+    if engine_result is not None:
+        svc.metrics.ttft.observe(engine_result.ttft_ms / 1000.0)
+        svc.metrics.gen_latency.observe(duration_ms / 1000.0)
+        svc.metrics.tokens_generated.inc(max(engine_result.completion_tokens, 0))
+        if engine_result.tokens_per_sec:
+            svc.metrics.tokens_per_sec.set(engine_result.tokens_per_sec)
+        if engine_result.prefix_cache_hit:
+            svc.metrics.prefix_cache_hits.inc()
+        engine_md = EngineMetadata(
+            queue_ms=engine_result.queue_ms,
+            prefill_ms=engine_result.prefill_ms,
+            decode_ms=engine_result.decode_ms,
+            ttft_ms=engine_result.ttft_ms,
+            prompt_tokens=engine_result.prompt_tokens,
+            completion_tokens=engine_result.completion_tokens,
+            tokens_per_sec=engine_result.tokens_per_sec,
+            prefix_cache_hit=engine_result.prefix_cache_hit,
+            engine=engine_result.engine,
+        )
+
+    body = CommandResponse(
+        kubectl_command=command,
+        execution_result=None,   # generation and execution are separate (B1, deliberate)
+        execution_error=None,
+        from_cache=from_cache,
+        metadata=ExecutionMetadata(**build_metadata(start_iso, t0, True)),
+        engine_metadata=engine_md,
+    )
+    return web.json_response(body.model_dump())
+
+
+async def handle_kubectl_command_stream(request: web.Request) -> web.StreamResponse:
+    """POST /kubectl-command/stream — SSE token stream (TPU-native addition
+    for the agent loop, BASELINE config 5)."""
+    svc: Service = request.app["service"]
+    try:
+        q = Query.model_validate(await request.json())
+    except (ValidationError, ValueError) as e:
+        return _json_error(400, f"Invalid input query: {e}")
+    sanitized_query = sanitize_query(q.query)
+    if len(sanitized_query) < 3:
+        return _json_error(400, "Invalid input query: too short after sanitation")
+
+    resp = web.StreamResponse(
+        status=200,
+        headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"},
+    )
+    await resp.prepare(request)
+
+    def sse(payload: str, event: Optional[str] = None) -> bytes:
+        # SSE framing: every payload line needs its own "data:" field —
+        # naive interpolation would corrupt multi-line token pieces.
+        lines = payload.split("\n") or [""]
+        frame = (f"event: {event}\n" if event else "") + "".join(
+            f"data: {line}\n" for line in lines
+        ) + "\n"
+        return frame.encode()
+
+    # Serve from the query→command cache when possible (same cache the
+    # non-streaming endpoint fills).
+    cached = svc.cache.cache.get(sanitized_query)
+    if cached is not None:
+        svc.metrics.cache_hits.inc()
+        await resp.write(sse(cached))
+        await resp.write(sse(cached, event="done"))
+        await resp.write_eof()
+        return resp
+
+    pieces: list[str] = []
+    try:
+        stream = svc.engine.generate_stream(
+            render_prompt(sanitized_query),
+            max_tokens=svc.cfg.max_new_tokens,
+            temperature=svc.cfg.temperature,
+            timeout=svc.cfg.llm_timeout,
+        )
+        async for piece in stream:
+            pieces.append(piece)
+            await resp.write(sse(piece))
+        try:
+            command = parse_llm_output("".join(pieces))
+            svc.cache.cache.put(sanitized_query, command)
+            svc.metrics.cache_misses.inc()
+            await resp.write(sse(command, event="done"))
+        except UnsafeCommandError as e:
+            svc.metrics.unsafe_commands.labels("llm").inc()
+            await resp.write(sse(str(e), event="error"))
+    except EngineUnavailable as e:
+        await resp.write(sse(f"engine unavailable: {e}", event="error"))
+    except (GenerationTimeout, asyncio.TimeoutError):
+        await resp.write(sse("LLM request timed out", event="error"))
+    except Exception:
+        # The 200 status is already on the wire; the best we can do is a
+        # structured error event rather than a silently truncated stream.
+        logger.exception("Stream generation failed for query '%s'", sanitized_query)
+        await resp.write(sse("internal error during generation", event="error"))
+    await resp.write_eof()
+    return resp
+
+
+async def handle_execute(request: web.Request) -> web.Response:
+    """POST /execute (reference app.py:356-389)."""
+    svc: Service = request.app["service"]
+    try:
+        req = ExecuteRequest.model_validate(await request.json())
+    except (ValidationError, ValueError) as e:
+        return _json_error(400, f"Invalid request: {e}")
+
+    logger.info("Received execute request for command: '%s'", req.execute)
+    from .safety import unsafe_reason
+
+    reason = unsafe_reason(req.execute)
+    if reason is not None:
+        svc.metrics.unsafe_commands.labels("user").inc()
+        return _json_error(400, f"Command failed safety checks: {reason}")
+
+    execution_data = await svc.executor.execute(req.execute)
+    outcome = "success" if execution_data["metadata"]["success"] else (
+        execution_data["metadata"].get("error_type") or "error"
+    )
+    svc.metrics.executions.labels(outcome).inc()
+
+    body = CommandResponse(
+        kubectl_command=req.execute,
+        execution_result=execution_data.get("execution_result"),
+        execution_error=execution_data.get("execution_error"),
+        from_cache=False,
+        metadata=ExecutionMetadata(**execution_data["metadata"]),
+    )
+    return web.json_response(body.model_dump())
+
+
+async def handle_health(request: web.Request) -> web.Response:
+    """GET /health — readiness-gated (SURVEY.md §3.3)."""
+    svc: Service = request.app["service"]
+    ready = bool(getattr(svc.engine, "ready", False))
+    devices = 0
+    try:
+        import jax
+
+        devices = len(jax.devices())
+    except Exception:
+        pass
+    body = HealthResponse(
+        status="healthy" if ready else "degraded",
+        engine=getattr(svc.engine, "name", "unknown"),
+        engine_ready=ready,
+        model=svc.cfg.model_name,
+        devices=devices,
+    )
+    return web.json_response(body.model_dump(), status=200 if ready else 503)
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    svc: Service = request.app["service"]
+    return web.Response(body=svc.metrics.render(), content_type="text/plain")
+
+
+def create_app(cfg: ServiceConfig, engine: Engine,
+               executor: Optional[CommandExecutor] = None,
+               metrics: Optional[Metrics] = None) -> web.Application:
+    """App factory (reference module init, app.py:130-138)."""
+    app = web.Application(
+        middlewares=[observability_middleware, ratelimit_middleware, auth_middleware]
+    )
+    app["service"] = Service(cfg, engine, executor=executor, metrics=metrics)
+
+    app.router.add_post("/kubectl-command", handle_kubectl_command)
+    app.router.add_post("/kubectl-command/stream", handle_kubectl_command_stream)
+    app.router.add_post("/execute", handle_execute)
+    app.router.add_get("/health", handle_health)
+    app.router.add_get("/metrics", handle_metrics)
+
+    async def _start_engine(app: web.Application) -> None:
+        await app["service"].engine.start()
+
+    async def _stop_engine(app: web.Application) -> None:
+        await app["service"].engine.stop()
+
+    app.on_startup.append(_start_engine)
+    app.on_cleanup.append(_stop_engine)
+    return app

@@ -1,0 +1,67 @@
+"""Engine selection from config (reference chain construction,
+app.py:106-122).
+
+On construction failure the service starts degraded and serves 503s —
+same behaviour as the reference's ``chain = None`` path (app.py:119-122,
+quirk B7, kept deliberately: a misconfigured model should not keep
+/health and /metrics down).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..config import ServiceConfig
+from ..engine.fake import FakeEngine
+from ..engine.openai_compat import OpenAICompatEngine
+from ..engine.protocol import Engine, EngineResult, EngineUnavailable
+
+logger = logging.getLogger(__name__)
+
+
+class DegradedEngine:
+    """Placeholder engine when construction failed: 503 on every call."""
+
+    name = "degraded"
+    ready = False
+
+    def __init__(self, reason: str):
+        self.reason = reason
+
+    async def start(self) -> None:
+        logger.error("Engine degraded: %s", self.reason)
+
+    async def stop(self) -> None:
+        pass
+
+    async def generate(self, prompt, **kw) -> EngineResult:
+        raise EngineUnavailable(self.reason)
+
+    async def generate_stream(self, prompt, **kw):
+        raise EngineUnavailable(self.reason)
+        yield  # pragma: no cover
+
+    def __repr__(self):  # pragma: no cover
+        return f"DegradedEngine({self.reason!r})"
+
+
+def build_engine(cfg: ServiceConfig) -> Engine:
+    try:
+        if cfg.engine == "fake":
+            return FakeEngine()
+        if cfg.engine == "openai":
+            return OpenAICompatEngine(
+                api_key=cfg.openai_api_key,
+                model=cfg.openai_model,
+                base_url=cfg.openai_base_url,
+                timeout=cfg.llm_timeout,
+            )
+        if cfg.engine == "jax":
+            from .. import engine as _engine_pkg  # noqa: F401
+            from ..engine.jax_engine import JaxEngine
+
+            return JaxEngine.from_config(cfg)
+        raise ValueError(f"Unknown ENGINE: {cfg.engine!r}")
+    except Exception as e:
+        logger.exception("Failed to initialize engine; starting degraded.")
+        return DegradedEngine(f"engine init failed: {e}")

@@ -1,0 +1,105 @@
+"""Prometheus metrics (reference app.py:136-138 + SURVEY.md §5 additions).
+
+The reference exposed default HTTP metrics via
+prometheus-fastapi-instrumentator. Here we register the equivalent request
+counters/latency histograms on ``prometheus_client`` directly, plus the
+engine-side gauges the TPU build adds: tokens/sec, batch occupancy, KV-pool
+usage, TTFT histogram, cache hit counters.
+
+A dedicated ``CollectorRegistry`` per app instance keeps tests isolated
+(prometheus_client's global registry rejects duplicate registration).
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+from prometheus_client.exposition import CONTENT_TYPE_LATEST
+
+_TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.15, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+_LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Metrics:
+    """All service + engine metrics for one app instance."""
+
+    content_type = CONTENT_TYPE_LATEST
+
+    def __init__(self) -> None:
+        self.registry = CollectorRegistry()
+        r = self.registry
+
+        # HTTP metrics (instrumentator parity)
+        self.http_requests = Counter(
+            "http_requests_total",
+            "Total HTTP requests",
+            ["method", "handler", "status"],
+            registry=r,
+        )
+        self.http_latency = Histogram(
+            "http_request_duration_seconds",
+            "HTTP request latency",
+            ["method", "handler"],
+            buckets=_LATENCY_BUCKETS,
+            registry=r,
+        )
+
+        # Service-layer metrics
+        self.cache_hits = Counter(
+            "response_cache_hits_total", "Query→command cache hits", registry=r
+        )
+        self.cache_misses = Counter(
+            "response_cache_misses_total", "Query→command cache misses", registry=r
+        )
+        self.rate_limited = Counter(
+            "rate_limited_total", "Requests rejected by the rate limiter", registry=r
+        )
+        self.unsafe_commands = Counter(
+            "unsafe_commands_total",
+            "Commands rejected by the safety validator",
+            ["source"],  # llm | user
+            registry=r,
+        )
+        self.executions = Counter(
+            "kubectl_executions_total", "kubectl subprocess runs", ["outcome"], registry=r
+        )
+
+        # Engine metrics (TPU-native additions, SURVEY.md §5)
+        self.ttft = Histogram(
+            "engine_ttft_seconds", "Time to first token", buckets=_TTFT_BUCKETS, registry=r
+        )
+        self.gen_latency = Histogram(
+            "engine_generate_seconds",
+            "Full generation latency",
+            buckets=_LATENCY_BUCKETS,
+            registry=r,
+        )
+        self.tokens_generated = Counter(
+            "engine_tokens_generated_total", "Completion tokens produced", registry=r
+        )
+        self.tokens_per_sec = Gauge(
+            "engine_tokens_per_sec", "Decode throughput of the last request", registry=r
+        )
+        self.batch_occupancy = Gauge(
+            "engine_batch_occupancy", "Active slots in the decode batch", registry=r
+        )
+        self.queue_depth = Gauge(
+            "engine_queue_depth", "Requests waiting for a decode slot", registry=r
+        )
+        self.kv_pool_used = Gauge(
+            "engine_kv_pages_used", "KV cache pages in use", registry=r
+        )
+        self.kv_pool_total = Gauge(
+            "engine_kv_pages_total", "KV cache pages allocated", registry=r
+        )
+        self.prefix_cache_hits = Counter(
+            "engine_prefix_cache_hits_total", "Prefix-KV cache hits", registry=r
+        )
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

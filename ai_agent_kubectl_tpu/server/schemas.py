@@ -1,0 +1,71 @@
+"""Request/response schemas (reference app.py:153-174), pydantic v2.
+
+The wire contract is kept byte-compatible with the reference:
+``Query{query}``, ``ExecuteRequest{execute}``, ``CommandResponse{
+kubectl_command, execution_result, execution_error, from_cache, metadata}``,
+``ExecutionMetadata{start_time, end_time, duration_ms, success,
+error_type?, error_code?}``.
+
+Additions (documented, additive-only): ``CommandResponse.engine_metadata``
+carries engine phase timings (queue/prefill/decode, TTFT) when a local
+engine served the request — the TPU-native analog of the reference's
+``duration_ms`` bookkeeping (app.py:164,227).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from pydantic import BaseModel, Field
+
+
+class Query(BaseModel):
+    query: str = Field(..., min_length=3, description="Natural language query for kubectl.")
+
+
+class ExecuteRequest(BaseModel):
+    execute: str = Field(..., description="kubectl command to execute.")
+
+
+class ExecutionMetadata(BaseModel):
+    start_time: str
+    end_time: str
+    duration_ms: float
+    success: bool
+    error_type: Optional[str] = None
+    error_code: Optional[str] = None
+
+
+class EngineMetadata(BaseModel):
+    """Per-request engine phase timings (TPU-native addition; SURVEY.md §5
+    tracing row)."""
+
+    queue_ms: float = 0.0
+    prefill_ms: float = 0.0
+    decode_ms: float = 0.0
+    ttft_ms: float = 0.0
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    tokens_per_sec: float = 0.0
+    prefix_cache_hit: bool = False
+    engine: str = ""
+
+
+class CommandResponse(BaseModel):
+    kubectl_command: str
+    execution_result: Optional[Dict[str, Any]] = None
+    execution_error: Optional[Dict[str, Any]] = None
+    from_cache: bool = False
+    metadata: ExecutionMetadata
+    engine_metadata: Optional[EngineMetadata] = None
+
+
+class HealthResponse(BaseModel):
+    """Readiness-gated health (fixes the reference's static /health,
+    app.py:348-354; SURVEY.md §3.3)."""
+
+    status: str
+    engine: str = ""
+    engine_ready: bool = False
+    model: str = ""
+    devices: int = 0

@@ -1,0 +1,83 @@
+"""Test harness configuration.
+
+- Forces JAX onto CPU with 8 virtual devices BEFORE jax imports, so real
+  mesh/pjit/collective code runs without a TPU (SURVEY.md §4,
+  distributed-without-a-cluster).
+- Provides minimal async-test support (no pytest-asyncio in the image):
+  ``async def test_*`` functions are run via ``asyncio.run``.
+- ``fake_kubectl`` fixture: a scriptable kubectl stand-in exercising the
+  executor (SURVEY.md §4, boundary 2).
+"""
+
+import asyncio
+import inspect
+import os
+import stat
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run coroutine test functions on a fresh event loop."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None
+
+
+FAKE_KUBECTL = r"""#!/usr/bin/env python3
+# Scriptable kubectl stand-in for executor tests.
+import os, sys, time
+
+args = sys.argv[1:]
+mode = os.environ.get("FAKE_KUBECTL_MODE", "table")
+
+if mode == "table":
+    sys.stdout.write(
+        "NAME                     READY   STATUS    RESTARTS   AGE   NOMINATED NODE\n"
+        "web-5d9c7b9df4-abcde     1/1     Running   0          2d    <none>\n"
+        "db-0                     1/1     Running   3          40d   node a1\n"
+    )
+    sys.exit(0)
+if mode == "raw":
+    sys.stdout.write("pod/web-5d9c7b9df4-abcde created")
+    sys.exit(0)
+if mode == "json":
+    sys.stdout.write('{"items": [{"kind": "Pod", "name": "web"}]}')
+    sys.exit(0)
+if mode == "error":
+    sys.stderr.write('Error from server (NotFound): pods "nope" not found\n')
+    sys.exit(1)
+if mode == "slow":
+    time.sleep(float(os.environ.get("FAKE_KUBECTL_SLEEP", "5")))
+    sys.stdout.write("done")
+    sys.exit(0)
+sys.stdout.write("ok")
+sys.exit(0)
+"""
+
+
+@pytest.fixture
+def fake_kubectl(tmp_path, monkeypatch):
+    """Writes a fake kubectl executable; returns its path. Select behaviour
+    via the FAKE_KUBECTL_MODE env var (table|raw|json|error|slow)."""
+    path = tmp_path / "kubectl"
+    path.write_text(FAKE_KUBECTL)
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path)

@@ -1,0 +1,301 @@
+"""Integration tests: full HTTP surface with FakeEngine + fake kubectl
+(SURVEY.md §4 integration row) — every status code enumerated at reference
+app.py:288-297 and app.py:360-367."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai_agent_kubectl_tpu.config import ServiceConfig
+from ai_agent_kubectl_tpu.engine.fake import FakeEngine
+from ai_agent_kubectl_tpu.engine.protocol import EngineUnavailable
+from ai_agent_kubectl_tpu.server.app import create_app
+from ai_agent_kubectl_tpu.server.executor import CommandExecutor
+
+
+def make_cfg(**over):
+    defaults = dict(engine="fake", model_name="fake", llm_timeout=2.0)
+    defaults.update(over)
+    return ServiceConfig(**defaults)
+
+
+async def make_client(cfg, engine=None, kubectl_binary="kubectl"):
+    engine = engine or FakeEngine()
+    executor = CommandExecutor(timeout=cfg.execution_timeout, kubectl_binary=kubectl_binary)
+    app = create_app(cfg, engine, executor=executor)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, engine
+
+
+async def test_kubectl_command_happy_path():
+    client, engine = await make_client(make_cfg())
+    try:
+        resp = await client.post("/kubectl-command", json={"query": "list all pods"})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["kubectl_command"] == "kubectl get pods"
+        assert body["from_cache"] is False
+        assert body["execution_result"] is None  # B1: generation only
+        assert body["metadata"]["success"] is True
+        assert body["engine_metadata"]["engine"] == "fake"
+
+        # Second identical query → cache hit
+        resp2 = await client.post("/kubectl-command", json={"query": "list all pods"})
+        body2 = await resp2.json()
+        assert body2["from_cache"] is True
+        assert engine.calls == 1
+    finally:
+        await client.close()
+
+
+async def test_kubectl_command_sanitizes_query():
+    client, engine = await make_client(make_cfg())
+    try:
+        r1 = await client.post("/kubectl-command", json={"query": "list\n\tall   pods"})
+        r2 = await client.post("/kubectl-command", json={"query": "list all pods"})
+        assert (await r1.json())["kubectl_command"] == (await r2.json())["kubectl_command"]
+        assert (await r2.json())["from_cache"] is True  # same sanitized key
+    finally:
+        await client.close()
+
+
+async def test_kubectl_command_400_validation():
+    client, _ = await make_client(make_cfg())
+    try:
+        assert (await client.post("/kubectl-command", json={"query": "ab"})).status == 400
+        assert (await client.post("/kubectl-command", json={})).status == 400
+        resp = await client.post(
+            "/kubectl-command", data=b"not json", headers={"Content-Type": "application/json"}
+        )
+        assert resp.status == 400
+    finally:
+        await client.close()
+
+
+async def test_kubectl_command_422_unsafe():
+    client, engine = await make_client(make_cfg())
+    try:
+        engine.scripted.append("kubectl get pods; rm -rf /")
+        resp = await client.post("/kubectl-command", json={"query": "do bad things"})
+        assert resp.status == 422
+        assert "unsafe" in (await resp.json())["detail"].lower()
+    finally:
+        await client.close()
+
+
+async def test_kubectl_command_fence_stripping_e2e():
+    client, engine = await make_client(make_cfg())
+    try:
+        engine.scripted.append("```bash\nkubectl get pods -n default\n```")
+        resp = await client.post("/kubectl-command", json={"query": "pods in default"})
+        assert resp.status == 200
+        assert (await resp.json())["kubectl_command"] == "kubectl get pods -n default"
+    finally:
+        await client.close()
+
+
+async def test_kubectl_command_503_degraded():
+    engine = FakeEngine()
+    client, _ = await make_client(make_cfg(), engine=engine)
+    try:
+        engine.fail_with = EngineUnavailable("engine down")
+        resp = await client.post("/kubectl-command", json={"query": "list pods"})
+        assert resp.status == 503
+    finally:
+        await client.close()
+
+
+async def test_kubectl_command_504_timeout():
+    engine = FakeEngine(delay=10.0)
+    client, _ = await make_client(make_cfg(llm_timeout=0.1), engine=engine)
+    try:
+        resp = await client.post("/kubectl-command", json={"query": "list pods"})
+        assert resp.status == 504
+    finally:
+        await client.close()
+
+
+async def test_kubectl_command_500_generic():
+    engine = FakeEngine()
+    client, _ = await make_client(make_cfg(), engine=engine)
+    try:
+        engine.fail_with = RuntimeError("kaboom")
+        resp = await client.post("/kubectl-command", json={"query": "list pods"})
+        assert resp.status == 500
+    finally:
+        await client.close()
+
+
+async def test_auth_401_paths():
+    client, _ = await make_client(make_cfg(api_auth_key="sekrit"))
+    try:
+        resp = await client.post("/kubectl-command", json={"query": "list pods"})
+        assert resp.status == 401
+        assert "Missing" in (await resp.json())["detail"]
+        resp = await client.post(
+            "/kubectl-command", json={"query": "list pods"}, headers={"X-API-Key": "wrong"}
+        )
+        assert resp.status == 401
+        resp = await client.post(
+            "/kubectl-command", json={"query": "list pods"}, headers={"X-API-Key": "sekrit"}
+        )
+        assert resp.status == 200
+        # health/metrics stay open (parity: reference only guards the two POSTs)
+        assert (await client.get("/health")).status == 200
+        assert (await client.get("/metrics")).status == 200
+    finally:
+        await client.close()
+
+
+async def test_rate_limit_429():
+    client, _ = await make_client(make_cfg(rate_limit="2/minute"))
+    try:
+        assert (await client.post("/kubectl-command", json={"query": "list pods"})).status == 200
+        assert (await client.post("/kubectl-command", json={"query": "list pods"})).status == 200
+        resp = await client.post("/kubectl-command", json={"query": "list pods"})
+        assert resp.status == 429
+        assert "Retry-After" in resp.headers
+    finally:
+        await client.close()
+
+
+async def test_execute_endpoint(fake_kubectl, monkeypatch):
+    monkeypatch.setenv("FAKE_KUBECTL_MODE", "table")
+    client, _ = await make_client(make_cfg(), kubectl_binary=fake_kubectl)
+    try:
+        resp = await client.post("/execute", json={"execute": "kubectl get pods"})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["execution_result"]["type"] == "table"
+        assert body["metadata"]["success"] is True
+
+        # 400 on unsafe command
+        resp = await client.post("/execute", json={"execute": "kubectl get pods; ls"})
+        assert resp.status == 400
+
+        # kubectl error → structured 200 (B2 fixed: no 500)
+        monkeypatch.setenv("FAKE_KUBECTL_MODE", "error")
+        resp = await client.post("/execute", json={"execute": "kubectl get pods"})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["execution_error"]["type"] == "kubectl_error"
+        assert body["metadata"]["success"] is False
+    finally:
+        await client.close()
+
+
+async def test_execute_timeout_structured(fake_kubectl, monkeypatch):
+    monkeypatch.setenv("FAKE_KUBECTL_MODE", "slow")
+    monkeypatch.setenv("FAKE_KUBECTL_SLEEP", "5")
+    client, _ = await make_client(make_cfg(execution_timeout=0.2), kubectl_binary=fake_kubectl)
+    try:
+        resp = await client.post("/execute", json={"execute": "kubectl get pods"})
+        assert resp.status == 200  # B2 fixed: structured error, not 500
+        body = await resp.json()
+        assert body["execution_error"]["type"] == "timeout"
+    finally:
+        await client.close()
+
+
+async def test_health_readiness_gated():
+    engine = FakeEngine()
+    client, _ = await make_client(make_cfg(), engine=engine)
+    try:
+        resp = await client.get("/health")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["status"] == "healthy" and body["engine_ready"] is True
+        await engine.stop()
+        resp = await client.get("/health")
+        assert resp.status == 503
+        assert (await resp.json())["status"] == "degraded"
+    finally:
+        await client.close()
+
+
+async def test_metrics_exposition():
+    client, _ = await make_client(make_cfg())
+    try:
+        await client.post("/kubectl-command", json={"query": "list pods"})
+        await client.post("/kubectl-command", json={"query": "list pods"})
+        text = await (await client.get("/metrics")).text()
+        assert "http_requests_total" in text
+        assert "response_cache_hits_total 1.0" in text
+        assert "engine_ttft_seconds" in text
+    finally:
+        await client.close()
+
+
+async def test_stream_endpoint():
+    client, engine = await make_client(make_cfg())
+    try:
+        engine.scripted.append("kubectl get pods -o wide")
+        resp = await client.post("/kubectl-command/stream", json={"query": "wide pods"})
+        assert resp.status == 200
+        text = await resp.text()
+        assert "event: done" in text
+        assert "kubectl get pods -o wide" in text
+    finally:
+        await client.close()
+
+
+async def test_concurrent_identical_queries_single_engine_call():
+    # Service-level single-flight (B4 fix) through the real HTTP stack.
+    engine = FakeEngine(delay=0.1)
+    client, _ = await make_client(make_cfg(rate_limit="100/minute"), engine=engine)
+    try:
+        tasks = [
+            client.post("/kubectl-command", json={"query": "list all pods"})
+            for _ in range(5)
+        ]
+        resps = await asyncio.gather(*tasks)
+        assert all(r.status == 200 for r in resps)
+        assert engine.calls == 1
+    finally:
+        await client.close()
+
+
+async def test_xff_not_trusted_by_default():
+    # Forged X-Forwarded-For must not mint fresh rate-limit buckets.
+    client, _ = await make_client(make_cfg(rate_limit="1/minute"))
+    try:
+        r1 = await client.post(
+            "/kubectl-command", json={"query": "list pods"},
+            headers={"X-Forwarded-For": "1.1.1.1"},
+        )
+        assert r1.status == 200
+        r2 = await client.post(
+            "/kubectl-command", json={"query": "list pods"},
+            headers={"X-Forwarded-For": "2.2.2.2"},
+        )
+        assert r2.status == 429
+    finally:
+        await client.close()
+
+
+async def test_stream_uses_and_fills_cache():
+    client, engine = await make_client(make_cfg())
+    try:
+        engine.scripted.append("kubectl get ns")
+        resp = await client.post("/kubectl-command/stream", json={"query": "all namespaces"})
+        assert "event: done" in await resp.text()
+        # Non-stream endpoint now hits the cache the stream filled.
+        resp2 = await client.post("/kubectl-command", json={"query": "all namespaces"})
+        body = await resp2.json()
+        assert body["from_cache"] is True and body["kubectl_command"] == "kubectl get ns"
+        assert engine.calls == 1
+    finally:
+        await client.close()
+
+
+async def test_stream_generic_engine_error_yields_error_event():
+    client, engine = await make_client(make_cfg())
+    try:
+        engine.fail_with = RuntimeError("boom")
+        resp = await client.post("/kubectl-command/stream", json={"query": "list pods"})
+        text = await resp.text()
+        assert "event: error" in text and "internal error" in text
+    finally:
+        await client.close()

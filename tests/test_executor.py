@@ -1,0 +1,79 @@
+"""Executor + table-parser tests against the fake kubectl binary
+(SURVEY.md §4, boundary 2). Covers B2 (structured errors w/ metadata on
+every path) and B6 (space-containing columns)."""
+
+import pytest
+
+from ai_agent_kubectl_tpu.server.executor import CommandExecutor, parse_kubectl_stdout
+
+
+def test_table_parser_handles_spaced_columns():
+    stdout = (
+        "NAME       READY   STATUS    NOMINATED NODE\n"
+        "web-1      1/1     Running   node a1\n"
+        "db-0       1/1     Running   <none>\n"
+    )
+    out = parse_kubectl_stdout(stdout)
+    assert out["type"] == "table"
+    assert out["data"][0]["nominated node"] == "node a1"  # B6: not split
+    assert out["data"][1]["name"] == "db-0"
+
+
+def test_table_parser_raw_and_json():
+    assert parse_kubectl_stdout("pod/web created") == {
+        "type": "raw",
+        "data": "pod/web created",
+    }
+    out = parse_kubectl_stdout('{"kind": "List", "items": []}')
+    assert out["type"] == "json" and out["data"]["kind"] == "List"
+    # Multi-line non-tabular text stays raw
+    text = "some text\nthat is not a table"
+    assert parse_kubectl_stdout(text)["type"] == "raw"
+
+
+async def test_execute_table(fake_kubectl, monkeypatch):
+    monkeypatch.setenv("FAKE_KUBECTL_MODE", "table")
+    ex = CommandExecutor(timeout=10, kubectl_binary=fake_kubectl)
+    result = await ex.execute("kubectl get pods")
+    assert result["metadata"]["success"] is True
+    assert result["execution_result"]["type"] == "table"
+    rows = result["execution_result"]["data"]
+    assert rows[0]["name"].startswith("web-")
+    assert rows[1]["nominated node"] == "node a1"
+    assert result["metadata"]["duration_ms"] > 0
+
+
+async def test_execute_error_maps_to_kubectl_error(fake_kubectl, monkeypatch):
+    monkeypatch.setenv("FAKE_KUBECTL_MODE", "error")
+    ex = CommandExecutor(timeout=10, kubectl_binary=fake_kubectl)
+    result = await ex.execute("kubectl get pods nope")
+    assert result["metadata"]["success"] is False
+    assert result["execution_error"]["type"] == "kubectl_error"
+    assert result["execution_error"]["code"] == "1"
+    assert "NotFound" in result["execution_error"]["message"]
+    assert result["metadata"]["error_code"] == "1"
+
+
+async def test_execute_timeout_has_metadata(fake_kubectl, monkeypatch):
+    # B2: the reference's timeout branch omitted metadata → endpoint 500.
+    monkeypatch.setenv("FAKE_KUBECTL_MODE", "slow")
+    monkeypatch.setenv("FAKE_KUBECTL_SLEEP", "5")
+    ex = CommandExecutor(timeout=0.2, kubectl_binary=fake_kubectl)
+    result = await ex.execute("kubectl get pods")
+    assert result["execution_error"]["type"] == "timeout"
+    assert result["metadata"]["success"] is False
+    assert result["metadata"]["error_type"] == "timeout"
+
+
+async def test_execute_missing_binary_has_metadata():
+    ex = CommandExecutor(timeout=5, kubectl_binary="/nonexistent/kubectl")
+    result = await ex.execute("kubectl get pods")
+    assert result["execution_error"]["code"] == "kubectl_not_found"
+    assert result["metadata"]["success"] is False
+
+
+async def test_execute_rejects_non_kubectl():
+    ex = CommandExecutor(timeout=5)
+    result = await ex.execute("ls -la")
+    assert result["execution_error"]["code"] == "not_kubectl"
+    assert result["metadata"]["success"] is False

@@ -1,0 +1,259 @@
+"""Unit tests for the service-layer primitives (SURVEY.md §4 unit row):
+sanitizer, safety validator (incl. B5 cases), output parser fence handling,
+TTL cache + single-flight, rate limiter, config parsing."""
+
+import asyncio
+
+import pytest
+
+from ai_agent_kubectl_tpu.config import ServiceConfig, load_env_file, parse_rate_limit
+from ai_agent_kubectl_tpu.server.cache import CachedSingleFlight, TTLCache
+from ai_agent_kubectl_tpu.server.output_parser import UnsafeCommandError, parse_llm_output
+from ai_agent_kubectl_tpu.server.ratelimit import SlidingWindowLimiter
+from ai_agent_kubectl_tpu.server.safety import is_safe_kubectl_command, unsafe_reason
+from ai_agent_kubectl_tpu.server.sanitize import sanitize_query
+
+
+# ---------------------------------------------------------------- sanitizer
+
+def test_sanitize_collapses_whitespace():
+    assert sanitize_query("  get\n\tall   pods\r\n") == "get all pods"
+    assert sanitize_query("plain") == "plain"
+    assert sanitize_query("   ") == ""
+
+
+# ---------------------------------------------------------- safety validator
+
+@pytest.mark.parametrize(
+    "command",
+    [
+        "kubectl get pods",
+        "kubectl get pods -n kube-system -o wide",
+        "kubectl logs web-0 --tail=100",
+        "kubectl scale deployment web --replicas=3",
+        'kubectl get pods -l "app=web,tier=frontend"',
+    ],
+)
+def test_safe_commands_accepted(command):
+    assert is_safe_kubectl_command(command)
+
+
+@pytest.mark.parametrize(
+    "command",
+    [
+        "rm -rf /",
+        "kubectl get pods; rm -rf /",
+        "kubectl get pods && echo hi",
+        "kubectl get pods || true",
+        "kubectl get pods | grep web",          # stricter than reference (single |)
+        "kubectl get pods & ",                   # stricter than reference (single &)
+        "kubectl get pods `whoami`",
+        "kubectl get pods $(whoami)",
+        "kubectl get pods > /etc/passwd",
+        "kubectl get pods < input",
+        'kubectl get pods -o jsonpath=$({range .items[*]})',
+        'kubectl get pods "unclosed',
+        "kubectlget pods",
+        "kubectl",
+    ],
+)
+def test_unsafe_commands_rejected(command):
+    assert not is_safe_kubectl_command(command)
+    assert unsafe_reason(command) is not None
+
+
+# ------------------------------------------------------------- output parser
+
+def test_parser_plain_command():
+    assert parse_llm_output(" kubectl get pods \n") == "kubectl get pods"
+
+
+def test_parser_strips_bare_fences():
+    assert parse_llm_output("```\nkubectl get pods\n```") == "kubectl get pods"
+
+
+def test_parser_strips_language_tag_fences():
+    # Quirk B5: reference missed ```bash fences (app.py:99-100).
+    assert parse_llm_output("```bash\nkubectl get pods\n```") == "kubectl get pods"
+
+
+def test_parser_strips_shell_prompt_and_extra_lines():
+    assert (
+        parse_llm_output("$ kubectl get pods\nThis lists all pods.")
+        == "kubectl get pods"
+    )
+
+
+def test_parser_raises_on_unsafe():
+    with pytest.raises(UnsafeCommandError):
+        parse_llm_output("rm -rf /")
+    with pytest.raises(UnsafeCommandError):
+        parse_llm_output("kubectl get pods; rm -rf /")
+
+
+# ---------------------------------------------------------------- TTL cache
+
+def test_ttlcache_basics_and_expiry():
+    clock = [0.0]
+    c = TTLCache(maxsize=2, ttl=10.0, timer=lambda: clock[0])
+    c.put("a", 1)
+    assert c.get("a") == 1
+    clock[0] = 9.9
+    assert c.get("a") == 1
+    clock[0] = 10.0
+    assert c.get("a") is None  # expired exactly at ttl
+    assert c.misses == 1
+
+
+def test_ttlcache_lru_eviction():
+    clock = [0.0]
+    c = TTLCache(maxsize=2, ttl=100.0, timer=lambda: clock[0])
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1      # touch a → b becomes LRU
+    c.put("c", 3)
+    assert c.get("b") is None
+    assert c.get("a") == 1 and c.get("c") == 3
+    assert c.evictions == 1
+
+
+async def test_single_flight_coalesces_concurrent_misses():
+    # Quirk B4: the reference let concurrent identical misses each call the
+    # LLM (app.py:312-322). Single-flight must collapse them to one call.
+    csf = CachedSingleFlight(maxsize=10, ttl=100.0)
+    calls = 0
+    gate = asyncio.Event()
+
+    async def supplier():
+        nonlocal calls
+        calls += 1
+        await gate.wait()
+        return "kubectl get pods"
+
+    tasks = [asyncio.create_task(csf.get_or_create("q", supplier)) for _ in range(5)]
+    await asyncio.sleep(0.01)
+    gate.set()
+    results = await asyncio.gather(*tasks)
+    assert calls == 1
+    values = [v for v, _ in results]
+    assert values == ["kubectl get pods"] * 5
+    from_cache_flags = sorted(fc for _, fc in results)
+    assert from_cache_flags.count(False) == 1  # exactly one caller generated
+
+
+async def test_single_flight_propagates_errors_and_recovers():
+    csf = CachedSingleFlight(maxsize=10, ttl=100.0)
+
+    async def boom():
+        raise RuntimeError("no")
+
+    with pytest.raises(RuntimeError):
+        await csf.get_or_create("q", boom)
+
+    async def ok():
+        return "kubectl get pods"
+
+    value, from_cache = await csf.get_or_create("q", ok)
+    assert value == "kubectl get pods" and from_cache is False
+
+
+# -------------------------------------------------------------- rate limiter
+
+def test_rate_limiter_window():
+    clock = [0.0]
+    rl = SlidingWindowLimiter(3, 60.0, timer=lambda: clock[0])
+    for _ in range(3):
+        allowed, _, _ = rl.check("1.2.3.4")
+        assert allowed
+    allowed, remaining, retry_after = rl.check("1.2.3.4")
+    assert not allowed and remaining == 0 and retry_after > 0
+    # Other clients unaffected
+    assert rl.check("5.6.7.8")[0]
+    # Window slides
+    clock[0] = 60.01
+    assert rl.check("1.2.3.4")[0]
+
+
+def test_rate_limiter_headers():
+    rl = SlidingWindowLimiter(10, 60.0)
+    h = rl.headers(0, 12.3)
+    assert h["Retry-After"] == "13"
+    assert h["X-RateLimit-Limit"] == "10"
+
+
+# -------------------------------------------------------------------- config
+
+def test_parse_rate_limit_formats():
+    assert parse_rate_limit("10/minute") == (10, 60.0)
+    assert parse_rate_limit("5/second") == (5, 1.0)
+    assert parse_rate_limit("100 per hour") == (100, 3600.0)
+    assert parse_rate_limit("5 per 30 second") == (5, 30.0)
+    with pytest.raises(ValueError):
+        parse_rate_limit("often")
+
+
+def test_config_from_env(monkeypatch):
+    monkeypatch.setenv("CACHE_MAXSIZE", "7")
+    monkeypatch.setenv("RATE_LIMIT", "2/second")
+    monkeypatch.setenv("API_AUTH_KEY", "sekrit")
+    monkeypatch.setenv("ENGINE", "fake")
+    cfg = ServiceConfig.from_env(env_file=None)
+    assert cfg.cache_maxsize == 7
+    assert cfg.rate_limit_count == 2 and cfg.rate_limit_window == 1.0
+    assert cfg.auth_enabled
+    assert cfg.describe()["api_auth_key"] == "***"
+
+
+def test_env_file_loader(tmp_path, monkeypatch):
+    envf = tmp_path / ".env"
+    envf.write_text(
+        "# comment\n"
+        "export MODEL_NAME=gemma-2b\n"
+        "CACHE_TTL='450'\n"
+        "EMPTY=\n"
+        "PORT=9000 # inline comment\n"
+    )
+    for k in ("MODEL_NAME", "CACHE_TTL", "PORT"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("PORT", "1234")  # process env wins
+    parsed = load_env_file(envf)
+    assert parsed["MODEL_NAME"] == "gemma-2b"
+    import os
+
+    assert os.environ["MODEL_NAME"] == "gemma-2b"
+    assert os.environ["CACHE_TTL"] == "450"
+    assert os.environ["PORT"] == "1234"
+    monkeypatch.delenv("MODEL_NAME", raising=False)
+    monkeypatch.delenv("CACHE_TTL", raising=False)
+
+
+# --------------------------------------------- code-review regression cases
+
+def test_parser_single_line_fence_with_kubectl_not_a_language_tag():
+    # '```kubectl get pods```' must not treat 'kubectl' as a fence tag.
+    assert parse_llm_output("```kubectl get pods```") == "kubectl get pods"
+
+
+async def test_single_flight_survives_waiter_cancellation():
+    # A coalesced waiter (or the first caller) disconnecting must not
+    # cancel the shared computation for everyone else.
+    csf = CachedSingleFlight(maxsize=10, ttl=100.0)
+    gate = asyncio.Event()
+    calls = 0
+
+    async def supplier():
+        nonlocal calls
+        calls += 1
+        await gate.wait()
+        return "kubectl get pods"
+
+    t1 = asyncio.create_task(csf.get_or_create("q", supplier))
+    await asyncio.sleep(0.01)
+    t2 = asyncio.create_task(csf.get_or_create("q", supplier))
+    await asyncio.sleep(0.01)
+    t1.cancel()  # first caller disconnects mid-generation
+    await asyncio.sleep(0.01)
+    gate.set()
+    value, _ = await t2
+    assert value == "kubectl get pods"
+    assert calls == 1
