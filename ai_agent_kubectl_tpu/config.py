@@ -119,7 +119,7 @@ class ServiceConfig:
     trust_proxy_headers: bool = False       # TRUST_PROXY_HEADERS
 
     # --- engine selection (replaces OPENAI_* block, app.py:34-36) ---
-    engine: str = "fake"                    # ENGINE: jax | fake | openai
+    engine: str = "jax"                     # ENGINE: jax | fake | openai
     model_name: str = "toy-8m"              # MODEL_NAME (registry key)
     model_path: Optional[str] = None        # MODEL_PATH (checkpoint dir)
     tokenizer_path: Optional[str] = None    # TOKENIZER_PATH
@@ -179,7 +179,7 @@ class ServiceConfig:
             host=_env_str("HOST", "0.0.0.0"),
             port=_env_int("PORT", 8000),
             trust_proxy_headers=_env_bool("TRUST_PROXY_HEADERS", False),
-            engine=(_env_str("ENGINE", "fake") or "fake").lower(),
+            engine=(_env_str("ENGINE", "jax") or "jax").lower(),
             model_name=_env_str("MODEL_NAME", "toy-8m"),
             model_path=_env_str("MODEL_PATH", None),
             tokenizer_path=_env_str("TOKENIZER_PATH", None),

@@ -1,0 +1,364 @@
+"""JaxEngine — the local TPU inference engine behind the service seam.
+
+This replaces the reference's remote ChatCompletion call (app.py:117,184)
+with an in-process engine (SURVEY.md §3.1 "TPU-native equivalent stack"):
+
+    tokenize → bucketed jit prefill → jit decode loop → detokenize
+
+Design:
+- **Bucketed prefill**: prompts are padded to the next bucket length
+  (PREFILL_BUCKETS) so jit sees a handful of static shapes; first request
+  per bucket pays compilation, everything after hits the cache.
+- **jit decode step**: one token per call, static shapes, KV cache
+  donated (``donate_argnums``) so XLA updates it in place in HBM rather
+  than copying ~GBs per token.
+- **Blocking JAX work runs on a worker thread** (``asyncio.to_thread``)
+  so the event loop keeps serving /health and /metrics during generation;
+  an asyncio.Lock serializes requests (the continuous-batching scheduler
+  in engine/batcher.py lifts this to admit-at-step concurrency).
+- Greedy decode at temperature=0 (reference parity, app.py:109).
+
+The single-sequence path here is also the numerical baseline the batched
+scheduler and Pallas-kernel paths are tested against.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from functools import partial
+from typing import AsyncIterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig, get_config
+from ..models.transformer import KVCache, forward, init_params
+from .protocol import EngineResult, EngineUnavailable, GenerationTimeout
+from .sampling import sample_token
+from .tokenizer import Tokenizer, load_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+def _dtype_from_str(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+            "float16": jnp.float16}[name]
+
+
+class JaxEngine:
+    name = "jax"
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        *,
+        tokenizer: Optional[Tokenizer] = None,
+        model_path: Optional[str] = None,
+        tokenizer_path: Optional[str] = None,
+        dtype: str = "bfloat16",
+        max_seq_len: int = 1024,
+        prefill_buckets: tuple = (64, 128, 256, 512, 1024),
+        attn_impl: str = "dense",
+        seed: int = 0,
+    ):
+        self.model_cfg = model_cfg
+        self.model_path = model_path
+        self.tokenizer_path = tokenizer_path
+        self.dtype = _dtype_from_str(dtype)
+        self.max_seq_len = min(max_seq_len, model_cfg.max_seq_len)
+        self.prefill_buckets = tuple(
+            b for b in sorted(prefill_buckets) if b <= self.max_seq_len
+        ) or (self.max_seq_len,)
+        self.attn_impl = attn_impl
+        self.seed = seed
+
+        self.tokenizer = tokenizer
+        self.params = None
+        self._ready = False
+        self._lock: Optional[asyncio.Lock] = None
+        self._prefill_fns = {}
+        self._decode_fn = None
+        self._sample_fns = {}
+
+    @classmethod
+    def from_config(cls, cfg) -> "JaxEngine":
+        model_cfg = get_config(cfg.model_name)
+        return cls(
+            model_cfg,
+            model_path=cfg.model_path,
+            tokenizer_path=cfg.tokenizer_path,
+            dtype=cfg.dtype,
+            max_seq_len=cfg.max_seq_len,
+            prefill_buckets=cfg.prefill_bucket_list,
+        )
+
+    # ------------------------------------------------------------ startup
+
+    @property
+    def ready(self) -> bool:
+        return self._ready
+
+    async def start(self) -> None:
+        await asyncio.to_thread(self._start_blocking)
+        self._lock = asyncio.Lock()
+        self._ready = True
+
+    def _start_blocking(self) -> None:
+        t0 = time.monotonic()
+        if self.tokenizer is None:
+            self.tokenizer = load_tokenizer(self.model_cfg, self.tokenizer_path)
+        if self.params is None:
+            if self.model_path:
+                from ..models.convert import convert_hf_checkpoint
+
+                logger.info("Loading checkpoint from %s", self.model_path)
+                self.params = convert_hf_checkpoint(
+                    self.model_cfg, self.model_path, dtype=self.dtype
+                )
+            else:
+                logger.warning(
+                    "No MODEL_PATH; random-initializing %s (toy/dev mode)",
+                    self.model_cfg.name,
+                )
+                self.params = init_params(
+                    jax.random.PRNGKey(self.seed), self.model_cfg, dtype=self.dtype
+                )
+
+        cfg = self.model_cfg
+
+        def prefill(params, tokens, positions, cache, *, kv_limit):
+            return forward(params, cfg, tokens, positions, cache,
+                           kv_limit=kv_limit, attn_impl=self.attn_impl)
+
+        def decode_step(params, tokens, positions, cache):
+            return forward(params, cfg, tokens, positions, cache,
+                           kv_limit=self.max_seq_len, attn_impl="dense")
+
+        # Donate the cache so decode updates KV in place in HBM.
+        self._decode_fn = jax.jit(decode_step, donate_argnums=(3,))
+        for b in self.prefill_buckets:
+            self._prefill_fns[b] = jax.jit(
+                partial(prefill, kv_limit=b), donate_argnums=(3,)
+            )
+
+        # Warm-up compile on the smallest bucket so the first request
+        # doesn't pay full compilation (SURVEY.md §3.3: init is where the
+        # heavy lifting moves).
+        b = self.prefill_buckets[0]
+        tokens = jnp.zeros((1, b), jnp.int32)
+        positions = jnp.broadcast_to(jnp.arange(b), (1, b))
+        cache = KVCache.zeros(cfg, 1, self.max_seq_len, dtype=self.dtype)
+        _, cache = self._prefill_fns[b](self.params, tokens, positions, cache)
+        step_tokens = jnp.zeros((1, 1), jnp.int32)
+        step_pos = jnp.full((1, 1), b, jnp.int32)
+        logits, _ = self._decode_fn(self.params, step_tokens, step_pos, cache)
+        logits.block_until_ready()
+        logger.info(
+            "Engine ready: %s (%.1fM params, %s, buckets=%s) in %.1fs",
+            cfg.name, cfg.param_count() / 1e6, np.dtype(self.dtype).name,
+            self.prefill_buckets, time.monotonic() - t0,
+        )
+
+    async def stop(self) -> None:
+        self._ready = False
+
+    # ----------------------------------------------------------- generate
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"Prompt of {n} tokens exceeds the largest prefill bucket "
+            f"{self.prefill_buckets[-1]}"
+        )
+
+    def _generate_blocking(self, prompt: str, max_tokens: int,
+                           temperature: float, deadline: Optional[float],
+                           cancel: Optional["threading.Event"] = None):
+        """Runs on a worker thread. Yields (event, payload) tuples:
+        ("token", text_piece) ... ("done", EngineResult)."""
+        cfg = self.model_cfg
+        t_start = time.monotonic()
+
+        # Clamp generation budget so the prompt always keeps >= 1 slot and
+        # decode positions can never run past the KV cache.
+        max_tokens = max(1, min(max_tokens, self.max_seq_len - 1))
+
+        prompt_ids = self.tokenizer.encode(prompt)
+        # Leave room to generate, and fit the largest prefill bucket
+        # (left-truncate: the query tail is the informative part).
+        max_prompt = min(self.max_seq_len - max_tokens, self.prefill_buckets[-1])
+        if len(prompt_ids) > max_prompt:
+            prompt_ids = prompt_ids[-max_prompt:]
+        n_prompt = len(prompt_ids)
+        bucket = self._bucket_for(n_prompt)
+
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n_prompt] = prompt_ids
+        # Padding slots keep their natural arange positions: their K/V lands
+        # in slots >= n_prompt, which decode steps overwrite before any
+        # query can attend to them (mask is kv_pos <= q_pos).
+        positions = np.broadcast_to(np.arange(bucket), (1, bucket)).astype(np.int32)
+
+        cache = KVCache.zeros(cfg, 1, self.max_seq_len, dtype=self.dtype)
+        t_prefill0 = time.monotonic()
+        logits, cache = self._prefill_fns[bucket](
+            self.params, jnp.asarray(tokens), jnp.asarray(positions), cache
+        )
+        # forward() records lengths from max(positions); restore the true
+        # prompt length so downstream consumers (batcher, prefix cache) see
+        # only valid context.
+        cache = KVCache(k=cache.k, v=cache.v,
+                        lengths=jnp.full((1,), n_prompt, jnp.int32))
+        # Next-token logits sit at the last *valid* prompt position.
+        last_logits = logits[:, n_prompt - 1]
+
+        key = jax.random.PRNGKey(self.seed + n_prompt)
+        # One cached jit wrapper per temperature (a fresh jax.jit per request
+        # would recompile every time).
+        sample = self._sample_fns.get(temperature)
+        if sample is None:
+            sample = self._sample_fns[temperature] = jax.jit(
+                partial(sample_token, temperature=temperature)
+            )
+
+        generated: list[int] = []
+        t_first = None
+        t_decode0 = time.monotonic()
+        prefill_ms = (t_decode0 - t_prefill0) * 1000.0
+
+        next_tok = sample(last_logits, key)
+        pos = n_prompt
+        finish = "length"
+        text = ""
+        emitted = 0  # chars of `text` already yielded
+        for i in range(max_tokens):
+            if deadline is not None and time.monotonic() > deadline:
+                raise GenerationTimeout("generation exceeded timeout")
+            if cancel is not None and cancel.is_set():
+                finish = "abort"
+                break
+            tok = int(next_tok[0])
+            if t_first is None:
+                t_first = time.monotonic()
+            if tok in cfg.eos_ids:
+                finish = "stop"
+                break
+            generated.append(tok)
+            # Incremental detokenization. A token can end mid-way through a
+            # multi-byte UTF-8 character (decode() shows U+FFFD); hold back
+            # trailing replacement chars until the next token resolves them,
+            # else the stream diverges from the final text.
+            text = self.tokenizer.decode(generated)
+            stable = len(text)
+            while stable > emitted and text[stable - 1] == "�" and len(text) - stable < 3:
+                stable -= 1
+            if stable > emitted:
+                yield ("token", text[emitted:stable])
+                emitted = stable
+            if i == max_tokens - 1:
+                break
+            key, subkey = jax.random.split(key)
+            step_logits, cache = self._decode_fn(
+                self.params,
+                jnp.asarray([[tok]], jnp.int32),
+                jnp.asarray([[pos]], jnp.int32),
+                cache,
+            )
+            next_tok = sample(step_logits[:, 0], subkey)
+            pos += 1
+
+        if emitted < len(text):
+            # Flush any held-back tail (genuinely invalid bytes stay U+FFFD).
+            yield ("token", text[emitted:])
+
+        t_end = time.monotonic()
+        decode_ms = (t_end - t_decode0) * 1000.0
+        result = EngineResult(
+            text=text,
+            prompt_tokens=n_prompt,
+            completion_tokens=len(generated),
+            prefill_ms=prefill_ms,
+            decode_ms=decode_ms,
+            ttft_ms=((t_first or t_end) - t_start) * 1000.0,
+            finish_reason=finish,
+            engine=self.name,
+        )
+        yield ("done", result)
+
+    async def generate(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> EngineResult:
+        result: Optional[EngineResult] = None
+        async for event, payload in self._stream_events(
+            prompt, max_tokens=max_tokens, temperature=temperature, timeout=timeout
+        ):
+            if event == "done":
+                result = payload
+        assert result is not None
+        return result
+
+    async def generate_stream(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> AsyncIterator[str]:
+        async for event, payload in self._stream_events(
+            prompt, max_tokens=max_tokens, temperature=temperature, timeout=timeout
+        ):
+            if event == "token":
+                yield payload
+
+    async def _stream_events(self, prompt: str, *, max_tokens: int,
+                             temperature: float, timeout: Optional[float]):
+        if not self._ready:
+            raise EngineUnavailable("JaxEngine not started")
+        t_queue0 = time.monotonic()
+        deadline = (t_queue0 + timeout) if timeout else None
+        async with self._lock:
+            queue_ms = (time.monotonic() - t_queue0) * 1000.0
+            loop = asyncio.get_running_loop()
+            cancel = threading.Event()
+            gen = self._generate_blocking(prompt, max_tokens, temperature,
+                                          deadline, cancel)
+            try:
+                while True:
+                    fut = loop.run_in_executor(None, next, gen, None)
+                    try:
+                        item = await fut
+                    except asyncio.CancelledError:
+                        # The worker thread may still be inside next(gen);
+                        # closing now would raise "generator already
+                        # executing" and leak the running generation. Signal
+                        # the decode loop and wait for the in-flight step.
+                        cancel.set()
+                        try:
+                            await asyncio.shield(fut)
+                        except BaseException:
+                            pass
+                        raise
+                    if item is None:
+                        break
+                    event, payload = item
+                    if event == "done":
+                        payload.queue_ms = queue_ms
+                    yield (event, payload)
+            finally:
+                cancel.set()
+                try:
+                    gen.close()  # generator is suspended here — safe
+                except ValueError:  # pragma: no cover - defensive
+                    pass

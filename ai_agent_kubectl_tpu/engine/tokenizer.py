@@ -1,0 +1,90 @@
+"""Tokenizer layer.
+
+The reference had no tokenizer (tokenization happened inside OpenAI's
+service, SURVEY.md §2.2). Two implementations behind one interface:
+
+- ``HFTokenizer``  — wraps a HuggingFace ``tokenizers`` fast tokenizer file
+  (tokenizer.json) for real checkpoints (Gemma/Llama/Mixtral).
+- ``ByteTokenizer`` — deterministic UTF-8 byte-level fallback for tests and
+  the toy models: token = byte + 3, specials pad=0/bos=1/eos=2. No files,
+  no network, fully reversible.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: int
+    eos_ids: tuple
+    pad_id: int
+
+    def encode(self, text: str, *, add_bos: bool = True) -> List[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + 3 special tokens. vocab = 259."""
+
+    SPECIALS = 3
+
+    def __init__(self, pad_id: int = 0, bos_id: int = 1, eos_id: int = 2):
+        self.pad_id = pad_id
+        self.bos_id = bos_id
+        self.eos_ids = (eos_id,)
+        self.vocab_size = 256 + self.SPECIALS
+
+    def encode(self, text: str, *, add_bos: bool = True) -> List[int]:
+        ids = [b + self.SPECIALS for b in text.encode("utf-8")]
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        # Ignore specials and out-of-byte-range ids (toy vocabs may be
+        # larger than 259; a random-init model can emit any id).
+        data = bytes(
+            i - self.SPECIALS
+            for i in ids
+            if self.SPECIALS <= i < 256 + self.SPECIALS
+        )
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """HuggingFace fast-tokenizer file (tokenizer.json)."""
+
+    def __init__(self, path: str | Path, bos_id: int, eos_ids: tuple, pad_id: int):
+        from tokenizers import Tokenizer as _Tok
+
+        self._tok = _Tok.from_file(str(path))
+        self.vocab_size = self._tok.get_vocab_size()
+        self.bos_id = bos_id
+        self.eos_ids = tuple(eos_ids)
+        self.pad_id = pad_id
+
+    def encode(self, text: str, *, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        specials = set(self.eos_ids) | {self.bos_id, self.pad_id}
+        return self._tok.decode([i for i in ids if i not in specials])
+
+
+def load_tokenizer(model_cfg, tokenizer_path: Optional[str]) -> Tokenizer:
+    """Pick the tokenizer for a model config: HF file when provided/found,
+    byte-level for toy models."""
+    if tokenizer_path:
+        p = Path(tokenizer_path)
+        if p.is_dir():
+            p = p / "tokenizer.json"
+        return HFTokenizer(p, model_cfg.bos_id, model_cfg.eos_ids, model_cfg.pad_id)
+    if model_cfg.name.startswith("toy"):
+        return ByteTokenizer()
+    raise FileNotFoundError(
+        f"No TOKENIZER_PATH configured for model {model_cfg.name!r} "
+        "(set TOKENIZER_PATH to a tokenizer.json or checkpoint dir)"
+    )

@@ -1,0 +1,6 @@
+"""Model families: a single parameterized decoder-only transformer
+(RMSNorm + RoPE + GQA + SwiGLU [+ MoE]) covering Gemma, Llama-3 and
+Mixtral (SURVEY.md §7 step 2), plus weight conversion from HF safetensors.
+"""
+
+from .config import ModelConfig, get_config, list_configs  # noqa: F401

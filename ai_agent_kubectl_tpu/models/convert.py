@@ -1,0 +1,145 @@
+"""HF safetensors checkpoint → framework parameter conversion.
+
+Maps HuggingFace Llama/Gemma/Mixtral checkpoints onto the layer-stacked
+param pytree ``transformer.init_params`` defines (SURVEY.md §7 hard part
+"weight conversion fidelity" — validated by logit-parity tests against the
+``transformers`` reference implementations in tests/test_convert.py).
+
+Layout notes:
+- HF ``nn.Linear`` stores [out_features, in_features]; our matmuls are
+  ``x @ w`` so every projection is transposed on load.
+- Per-layer tensors are stacked along a leading ``n_layers`` axis (the scan
+  layout), so conversion is stream-friendly: one layer at a time, never two
+  copies of the full model in host RAM.
+- HF Llama/Gemma/Mixtral all use the rotate-half RoPE convention, matching
+  ``ops.rope.apply_rope`` — no head permutation needed.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+
+def _open_checkpoint(path: str | Path) -> Tuple[Callable[[str], np.ndarray], List[str]]:
+    """Return (tensor_getter, key_list) over one or many .safetensors files."""
+    from safetensors import safe_open
+
+    path = Path(path)
+    files = sorted(path.glob("*.safetensors")) if path.is_dir() else [path]
+    if not files:
+        raise FileNotFoundError(f"No .safetensors files under {path}")
+    handles = [safe_open(str(f), framework="np") for f in files]
+    index: Dict[str, Any] = {}
+    for h in handles:
+        for k in h.keys():
+            index[k] = h
+    keys = list(index)
+
+    def get(key: str) -> np.ndarray:
+        return index[key].get_tensor(key)
+
+    return get, keys
+
+
+def _to_dtype(x: np.ndarray, dtype) -> jnp.ndarray:
+    return jnp.asarray(x).astype(dtype)
+
+
+def convert_hf_checkpoint(
+    cfg: ModelConfig,
+    path: str | Path,
+    dtype=jnp.bfloat16,
+) -> Dict[str, Any]:
+    """Convert an HF checkpoint directory/file to framework params."""
+    get, keys = _open_checkpoint(path)
+    pfx = "model." if any(k.startswith("model.") for k in keys) else ""
+    L = cfg.n_layers
+
+    def t(key: str) -> np.ndarray:  # transpose linear
+        return get(key).T
+
+    def stack(fn: Callable[[int], np.ndarray]) -> jnp.ndarray:
+        return jnp.stack([_to_dtype(fn(i), dtype) for i in range(L)])
+
+    layers: Dict[str, Any] = {
+        "attn_norm": stack(lambda i: get(f"{pfx}layers.{i}.input_layernorm.weight")),
+        "mlp_norm": stack(lambda i: get(f"{pfx}layers.{i}.post_attention_layernorm.weight")),
+        "wq": stack(lambda i: t(f"{pfx}layers.{i}.self_attn.q_proj.weight")),
+        "wk": stack(lambda i: t(f"{pfx}layers.{i}.self_attn.k_proj.weight")),
+        "wv": stack(lambda i: t(f"{pfx}layers.{i}.self_attn.v_proj.weight")),
+        "wo": stack(lambda i: t(f"{pfx}layers.{i}.self_attn.o_proj.weight")),
+    }
+
+    if cfg.is_moe:
+        E = cfg.n_experts
+        layers["router"] = stack(
+            lambda i: t(f"{pfx}layers.{i}.block_sparse_moe.gate.weight")
+        )
+        # experts.{e}.w1 = gate [F, D], w3 = up [F, D], w2 = down [D, F]
+        layers["w_gate"] = jnp.stack([
+            jnp.stack([
+                _to_dtype(t(f"{pfx}layers.{i}.block_sparse_moe.experts.{e}.w1.weight"), dtype)
+                for e in range(E)
+            ]) for i in range(L)
+        ])
+        layers["w_up"] = jnp.stack([
+            jnp.stack([
+                _to_dtype(t(f"{pfx}layers.{i}.block_sparse_moe.experts.{e}.w3.weight"), dtype)
+                for e in range(E)
+            ]) for i in range(L)
+        ])
+        layers["w_down"] = jnp.stack([
+            jnp.stack([
+                _to_dtype(t(f"{pfx}layers.{i}.block_sparse_moe.experts.{e}.w2.weight"), dtype)
+                for e in range(E)
+            ]) for i in range(L)
+        ])
+    else:
+        layers["w_gate"] = stack(lambda i: t(f"{pfx}layers.{i}.mlp.gate_proj.weight"))
+        layers["w_up"] = stack(lambda i: t(f"{pfx}layers.{i}.mlp.up_proj.weight"))
+        layers["w_down"] = stack(lambda i: t(f"{pfx}layers.{i}.mlp.down_proj.weight"))
+
+    params: Dict[str, Any] = {
+        "embed": _to_dtype(get(f"{pfx}embed_tokens.weight"), dtype),
+        "layers": layers,
+        "final_norm": _to_dtype(get(f"{pfx}norm.weight"), dtype),
+    }
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" in keys:
+            params["lm_head"] = _to_dtype(get("lm_head.weight").T, dtype)
+        else:
+            logger.warning("lm_head.weight absent; tying to embeddings")
+            params["lm_head"] = params["embed"].T
+
+    _validate_shapes(cfg, params)
+    return params
+
+
+def _validate_shapes(cfg: ModelConfig, params: Dict[str, Any]) -> None:
+    d, hd, H, KV, L = cfg.dim, cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    expect = {
+        ("embed",): (cfg.vocab_size, d),
+        ("final_norm",): (d,),
+        ("layers", "wq"): (L, d, H * hd),
+        ("layers", "wk"): (L, d, KV * hd),
+        ("layers", "wv"): (L, d, KV * hd),
+        ("layers", "wo"): (L, H * hd, d),
+    }
+    for keypath, shape in expect.items():
+        node: Any = params
+        for k in keypath:
+            node = node[k]
+        if tuple(node.shape) != shape:
+            raise ValueError(
+                f"Checkpoint/config mismatch at {'.'.join(keypath)}: "
+                f"got {tuple(node.shape)}, expected {shape} for {cfg.name}"
+            )

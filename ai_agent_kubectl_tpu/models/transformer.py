@@ -1,0 +1,223 @@
+"""Pure-JAX decoder-only transformer, parameterized by ``ModelConfig``.
+
+Design (TPU-first, not a port — the reference has no model code at all,
+SURVEY.md §3.5):
+
+- **Functional**: parameters are a plain pytree; ``forward`` is a pure
+  function of (params, tokens, positions, cache). No module framework —
+  nothing between the code and XLA.
+- **Layer-stacked + lax.scan**: per-layer params are stacked on a leading
+  ``n_layers`` axis and the layer loop is a ``lax.scan``. One layer gets
+  traced/compiled once regardless of depth — an 80-layer Llama-70B compiles
+  in roughly the time of one layer, and XLA still overlaps per-layer
+  collectives with compute.
+- **Static shapes everywhere**: tokens are padded to bucket sizes; the KV
+  cache is a fixed [L, B, S, KV, d] buffer with explicit write positions, so
+  jit never recompiles across requests (SURVEY.md §7 hard part "continuous
+  batching × jit").
+- **Explicit positions**: RoPE and causal masks take absolute positions, so
+  prefix-KV splicing and ragged decode are correct by construction.
+- **bf16 params/activations, f32 softmax/norm accumulation.**
+
+Attention backend is pluggable (``attn_impl``): "dense" (ops/attention.py
+reference) or "flash" (Pallas, ops/flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dense_attention
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Contiguous per-slot KV cache.
+
+    k, v:    [n_layers, batch, max_seq, n_kv_heads, head_dim]
+    lengths: [batch] — number of valid positions per slot
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    lengths: jnp.ndarray
+
+    @classmethod
+    def zeros(cls, cfg: ModelConfig, batch: int, max_seq: int,
+              dtype=jnp.bfloat16) -> "KVCache":
+        shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
+            lengths=jnp.zeros((batch,), dtype=jnp.int32),
+        )
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[2]
+
+
+# ----------------------------------------------------------------- init
+
+def init_params(key: jax.Array, cfg: ModelConfig, dtype=jnp.bfloat16) -> Params:
+    """Random init (scaled normal) with the layer axis stacked for scan."""
+
+    def _dense_init(k, shape, scale):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    keys = iter(jax.random.split(key, 16))
+    d, hd, H, KV, F, L = (cfg.dim, cfg.head_dim, cfg.n_heads, cfg.n_kv_heads,
+                          cfg.mlp_hidden, cfg.n_layers)
+    s_in = d ** -0.5
+    s_mlp = F ** -0.5
+
+    layers: Params = {
+        "attn_norm": jnp.zeros((L, d), dtype) if cfg.rms_offset else jnp.ones((L, d), dtype),
+        "wq": _dense_init(next(keys), (L, d, H * hd), s_in),
+        "wk": _dense_init(next(keys), (L, d, KV * hd), s_in),
+        "wv": _dense_init(next(keys), (L, d, KV * hd), s_in),
+        "wo": _dense_init(next(keys), (L, H * hd, d), (H * hd) ** -0.5),
+        "mlp_norm": jnp.zeros((L, d), dtype) if cfg.rms_offset else jnp.ones((L, d), dtype),
+    }
+    if cfg.is_moe:
+        E = cfg.n_experts
+        layers["router"] = _dense_init(next(keys), (L, d, E), s_in)
+        layers["w_gate"] = _dense_init(next(keys), (L, E, d, F), s_in)
+        layers["w_up"] = _dense_init(next(keys), (L, E, d, F), s_in)
+        layers["w_down"] = _dense_init(next(keys), (L, E, F, d), s_mlp)
+    else:
+        layers["w_gate"] = _dense_init(next(keys), (L, d, F), s_in)
+        layers["w_up"] = _dense_init(next(keys), (L, d, F), s_in)
+        layers["w_down"] = _dense_init(next(keys), (L, F, d), s_mlp)
+
+    params: Params = {
+        "embed": _dense_init(next(keys), (cfg.vocab_size, d), 1.0),
+        "layers": layers,
+        "final_norm": jnp.zeros((d,), dtype) if cfg.rms_offset else jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense_init(next(keys), (d, cfg.vocab_size), s_in)
+    return params
+
+
+# -------------------------------------------------------------- blocks
+
+def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.activation == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _dense_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    gate = _activation(cfg, x @ lp["w_gate"])
+    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+
+
+def _moe_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Dense (all-experts) MoE evaluation — the single-device reference.
+
+    Evaluates every expert and mixes by top-k router weights. Correct for
+    any batch; the expert-parallel dispatch path (parallel/moe.py) is the
+    scaled version and is tested against this.
+    """
+    from ..parallel.moe import dense_moe
+
+    return dense_moe(cfg, lp, x)
+
+
+def _layer(cfg: ModelConfig, attn_impl: str, h: jnp.ndarray, lp: Params,
+           layer_k: jnp.ndarray, layer_v: jnp.ndarray,
+           positions: jnp.ndarray, kv_limit: int,
+           batch_idx: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One transformer block. Returns (h_out, new_layer_k, new_layer_v)."""
+    B, S, d = h.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    x = rms_norm(h, lp["attn_norm"], cfg.rms_eps, cfg.rms_offset)
+    q = (x @ lp["wq"]).reshape(B, S, H, hd)
+    k = (x @ lp["wk"]).reshape(B, S, KV, hd)
+    v = (x @ lp["wv"]).reshape(B, S, KV, hd)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    # Write this chunk's K/V into the cache at its absolute positions.
+    # (scatter; positions are per-slot absolute indices)
+    layer_k = layer_k.at[batch_idx, positions].set(k.astype(layer_k.dtype))
+    layer_v = layer_v.at[batch_idx, positions].set(v.astype(layer_v.dtype))
+
+    k_ctx = layer_k[:, :kv_limit]
+    v_ctx = layer_v[:, :kv_limit]
+    # Causal mask over absolute positions (padding queries read garbage but
+    # their outputs are never used).
+    kv_pos = jnp.arange(kv_limit)[None, None, :]
+    mask = kv_pos <= positions[:, :, None]
+
+    if attn_impl == "flash" and S > 1:
+        from ..ops.flash_attention import flash_attention_cached
+
+        attn = flash_attention_cached(q, k_ctx, v_ctx, positions)
+    else:
+        attn = dense_attention(q, k_ctx, v_ctx, mask)
+    h = h + attn.reshape(B, S, H * hd) @ lp["wo"]
+
+    x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
+    mlp = _moe_mlp(cfg, lp, x) if cfg.is_moe else _dense_mlp(cfg, lp, x)
+    return h + mlp, layer_k, layer_v
+
+
+# -------------------------------------------------------------- forward
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,          # [B, S] int32
+    positions: jnp.ndarray,       # [B, S] int32 absolute positions
+    cache: KVCache,
+    *,
+    kv_limit: Optional[int] = None,   # static: attend over cache[:, :kv_limit]
+    attn_impl: str = "dense",
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the model over a token chunk (prefill: S>1; decode: S=1).
+
+    Returns (logits [B, S, vocab], updated cache). ``cache.lengths`` is
+    advanced by the number of *valid* tokens, which the caller tracks —
+    here we set it to max(positions)+1 per slot (padding positions are
+    clamped by the caller).
+    """
+    if kv_limit is None:
+        kv_limit = cache.max_seq
+    B, S = tokens.shape
+    batch_idx = jnp.arange(B)[:, None]
+
+    h = params["embed"][tokens]
+    if cfg.embed_scale:
+        h = h * jnp.asarray(cfg.dim ** 0.5, h.dtype)
+
+    step = partial(_layer, cfg, "dense" if attn_impl == "dense" else attn_impl)
+
+    def scan_body(h, xs):
+        lp, layer_k, layer_v = xs
+        h, new_k, new_v = step(h, lp, layer_k, layer_v, positions, kv_limit, batch_idx)
+        return h, (new_k, new_v)
+
+    h, (new_k, new_v) = jax.lax.scan(scan_body, h, (params["layers"], cache.k, cache.v))
+
+    h = rms_norm(h, params["final_norm"], cfg.rms_eps, cfg.rms_offset)
+    if cfg.tie_embeddings:
+        logits = h @ params["embed"].astype(h.dtype).T
+    else:
+        logits = h @ params["lm_head"]
+
+    new_lengths = jnp.maximum(cache.lengths, positions.max(axis=1) + 1)
+    return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v, lengths=new_lengths)

@@ -1,0 +1,12 @@
+"""Numeric ops: TPU kernels (Pallas) and their dense JAX references.
+
+Layout:
+- ``norms``           — RMSNorm (f32 accumulation)
+- ``rope``            — rotary position embeddings with offset support
+- ``attention``       — dense reference attention (GQA, causal, cached) +
+                        backend dispatch
+- ``flash_attention`` — Pallas flash attention (prefill)
+- ``paged_attention`` — Pallas paged-KV ragged decode attention
+- ``ring_attention``  — sequence-parallel ring attention over a mesh axis
+- ``quant``           — int8 quantized matmul kernels
+"""

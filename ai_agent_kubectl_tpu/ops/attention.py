@@ -1,0 +1,76 @@
+"""Dense reference attention (GQA, causal, KV-cache aware) + backend dispatch.
+
+This is the numerically-trusted baseline every Pallas kernel is tested
+against (SURVEY.md §4: kernel unit tests vs dense reference). It is also a
+perfectly good TPU program for small shapes: one fused softmax(QK^T)V chain
+that XLA maps straight onto the MXU.
+
+Conventions:
+- q:  [batch, q_len, n_heads, head_dim]
+- k/v: [batch, kv_len, n_kv_heads, head_dim]   (GQA: n_kv_heads divides n_heads)
+- mask: bool [batch, q_len, kv_len] or None — True = attend.
+- softmax in float32, output in q.dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def repeat_kv(kv: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand KV heads for GQA: [b, s, n_kv, d] -> [b, s, n_kv * n_rep, d]."""
+    if n_rep == 1:
+        return kv
+    b, s, h, d = kv.shape
+    return jnp.broadcast_to(kv[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def dense_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    *,
+    scale: Optional[float] = None,
+    logit_softcap: float = 0.0,
+) -> jnp.ndarray:
+    """softmax(q k^T / sqrt(d) [+ mask]) v with GQA head expansion.
+
+    ``logit_softcap`` applies Gemma-2-style tanh capping when > 0.
+    """
+    n_heads = q.shape[2]
+    n_kv = k.shape[2]
+    k = repeat_kv(k, n_heads // n_kv)
+    v = repeat_kv(v, n_heads // n_kv)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if logit_softcap > 0.0:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
+    if mask is not None:
+        logits = jnp.where(mask[:, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def causal_mask(q_len: int, kv_len: int, q_offset: jnp.ndarray | int = 0) -> jnp.ndarray:
+    """[1, q_len, kv_len] causal mask: query i (at absolute position
+    q_offset + i) may attend to kv position j iff j <= q_offset + i."""
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return (kv_pos <= q_pos)[None, :, :]
+
+
+def length_mask(kv_lens: jnp.ndarray, kv_len: int) -> jnp.ndarray:
+    """[batch, 1, kv_len] validity mask for padded caches: position j is
+    valid iff j < kv_lens[b]."""
+    return (jnp.arange(kv_len)[None, None, :] < kv_lens[:, None, None])

@@ -1,0 +1,26 @@
+"""RMSNorm (used by Gemma/Llama/Mixtral alike).
+
+TPU note: normalization statistics accumulate in float32 even for bfloat16
+activations — the VPU cost is negligible next to the MXU matmuls, and it
+avoids bf16 variance underflow. XLA fuses this whole op into neighbours.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6,
+             offset: float = 0.0) -> jnp.ndarray:
+    """y = x / rms(x) * (offset + weight).
+
+    ``offset=1.0`` gives Gemma's (1 + w) parameterization; 0.0 gives
+    Llama/Mixtral's plain w.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    scale = offset + weight.astype(jnp.float32)
+    return (normed * scale).astype(dtype)

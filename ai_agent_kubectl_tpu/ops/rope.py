@@ -1,0 +1,38 @@
+"""Rotary position embeddings (RoPE) with explicit position indices.
+
+Positions are always passed explicitly (shape [batch, seq]) rather than
+derived from array offsets — this is what makes prefix-KV splicing and
+paged decode correct: a token's rotation depends on its absolute position
+in the logical sequence, not on where its KV happens to live in cache
+memory (SURVEY.md §7, "Prefix-KV sharing" hard part).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
+    """Inverse frequencies, shape [head_dim // 2], float32."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponents)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float = 10000.0) -> jnp.ndarray:
+    """Rotate q or k.
+
+    x:          [batch, seq, n_heads, head_dim]
+    positions:  [batch, seq] absolute token positions (int32)
+
+    Uses the "split halves" convention (dims [0:d/2] pair with [d/2:d]),
+    matching HF Llama/Gemma/Mixtral — required for converted checkpoints to
+    be numerically faithful.
+    """
+    head_dim = x.shape[-1]
+    inv_freq = rope_frequencies(head_dim, theta)              # [d/2]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [b, s, d/2]
+    cos = jnp.cos(angles)[:, :, None, :]                      # [b, s, 1, d/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)

@@ -1,0 +1,12 @@
+"""Parallelism: device meshes, sharding policies, and distributed init.
+
+The TPU-native communication layer (SURVEY.md §2.4): there is no NCCL/MPI
+transport to write — mesh axes + ``NamedSharding`` PartitionSpecs ARE the
+comm API, and XLA inserts all-gather/reduce-scatter/all-to-all over ICI
+(intra-slice) and DCN (inter-slice) from the sharding annotations.
+
+- ``mesh``        — mesh construction from config strings
+- ``sharding``    — PartitionSpec policies for params/activations/KV (TP/DP/EP/SP)
+- ``moe``         — MoE: dense reference + expert-parallel dispatch
+- ``distributed`` — multi-host jax.distributed initialization
+"""

@@ -1,0 +1,52 @@
+"""Mixture-of-Experts MLP: dense reference + expert-parallel dispatch.
+
+``dense_moe`` evaluates every expert and mixes by router weights — O(E)
+FLOPs but correct for any batch and trivially shardable; it is the
+numerical reference for the EP path and what small/test configs use.
+
+Routing follows Mixtral (top-k over router logits, softmax *after*
+selection, renormalized over the selected experts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+
+
+def router_weights(cfg: ModelConfig, logits: jnp.ndarray):
+    """Top-k routing. logits [..., E] -> (mix [..., E], idx [..., k]).
+
+    ``mix`` is dense over E with zeros off the top-k — dense mixing keeps
+    the op jit-friendly (no ragged gathers) and maps to pure VPU work.
+    """
+    k = cfg.experts_per_token
+    top_vals, top_idx = jax.lax.top_k(logits, k)                  # [..., k]
+    top_w = jax.nn.softmax(top_vals.astype(jnp.float32), axis=-1)  # renorm over k
+    mix = jnp.zeros(logits.shape, dtype=jnp.float32)
+    mix = jnp.put_along_axis(mix, top_idx, top_w, axis=-1, inplace=False)
+    return mix, top_idx
+
+
+def dense_moe(cfg: ModelConfig, lp: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+    """All-experts evaluation: x [B, S, D] -> [B, S, D].
+
+    w_gate/w_up: [E, D, F], w_down: [E, F, D], router: [D, E].
+    """
+    logits = (x @ lp["router"]).astype(jnp.float32)               # [B, S, E]
+    mix, _ = router_weights(cfg, logits)
+
+    gate = jnp.einsum("bsd,edf->bsef", x, lp["w_gate"])
+    up = jnp.einsum("bsd,edf->bsef", x, lp["w_up"])
+    if cfg.activation == "gelu":
+        act = jax.nn.gelu(gate, approximate=True)
+    else:
+        act = jax.nn.silu(gate)
+    hidden = act * up                                             # [B, S, E, F]
+    y = jnp.einsum("bsef,efd->bsed", hidden, lp["w_down"])        # [B, S, E, D]
+    return jnp.einsum("bsed,bse->bsd", y.astype(jnp.float32),
+                      mix).astype(x.dtype)

@@ -16,12 +16,21 @@ import stat
 import sys
 from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force tests onto CPU. The host environment pins JAX to the TPU plugin and
+# rewrites jax_platforms at import time (the env var alone is ignored), and
+# on TPU "f32" matmuls run at bf16 MXU precision — numerics tests would
+# silently compare bf16 against themselves. jax.config.update after import
+# is the override that sticks.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 

@@ -1,0 +1,120 @@
+"""Weight-conversion fidelity: logit parity against the HuggingFace
+``transformers`` reference implementations on tiny random-init models
+(SURVEY.md §4 numerics row; §7 hard part "weight conversion fidelity").
+
+A tiny HF model is instantiated, saved as safetensors, converted with
+``convert_hf_checkpoint``, and both implementations must produce matching
+logits (f32, CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ai_agent_kubectl_tpu.models.config import ModelConfig
+from ai_agent_kubectl_tpu.models.convert import convert_hf_checkpoint
+from ai_agent_kubectl_tpu.models.transformer import KVCache, forward
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def run_ours(cfg, params, token_ids):
+    tokens = jnp.asarray([token_ids], dtype=jnp.int32)
+    S = tokens.shape[1]
+    positions = jnp.broadcast_to(jnp.arange(S), (1, S))
+    cache = KVCache.zeros(cfg, 1, S, dtype=jnp.float32)
+    logits, _ = forward(params, cfg, tokens, positions, cache, kv_limit=S)
+    return np.asarray(logits[0])
+
+
+def assert_logit_parity(hf_logits, our_logits, atol=2e-3):
+    np.testing.assert_allclose(our_logits, hf_logits, rtol=1e-3, atol=atol)
+    # Greedy-decode determinism: argmax must agree everywhere
+    assert np.array_equal(our_logits.argmax(-1), hf_logits.argmax(-1))
+
+
+def test_llama_conversion_logit_parity(tmp_path):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = ModelConfig(
+        name="tiny-llama", vocab_size=128, dim=64, n_layers=3, n_heads=4,
+        n_kv_heads=2, head_dim=16, mlp_hidden=176, rope_theta=10000.0,
+        rms_eps=1e-5,
+    )
+    params = convert_hf_checkpoint(cfg, tmp_path, dtype=jnp.float32)
+
+    token_ids = [1, 17, 89, 5, 42, 77, 3]
+    with torch.no_grad():
+        hf_logits = model(torch.tensor([token_ids])).logits[0].numpy()
+    assert_logit_parity(hf_logits, run_ours(cfg, params, token_ids))
+
+
+def test_gemma_conversion_logit_parity(tmp_path):
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=1, head_dim=16,
+        rms_norm_eps=1e-6, rope_theta=10000.0,
+        hidden_act="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(1)
+    model = transformers.GemmaForCausalLM(hf_cfg).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = ModelConfig(
+        name="tiny-gemma", vocab_size=128, dim=64, n_layers=2, n_heads=4,
+        n_kv_heads=1, head_dim=16, mlp_hidden=176, rms_offset=1.0,
+        activation="gelu", tie_embeddings=True, embed_scale=True,
+    )
+    params = convert_hf_checkpoint(cfg, tmp_path, dtype=jnp.float32)
+
+    token_ids = [2, 9, 101, 55, 23]
+    with torch.no_grad():
+        hf_logits = model(torch.tensor([token_ids])).logits[0].numpy()
+    assert_logit_parity(hf_logits, run_ours(cfg, params, token_ids))
+
+
+def test_mixtral_conversion_logit_parity(tmp_path):
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+    )
+    torch.manual_seed(2)
+    model = transformers.MixtralForCausalLM(hf_cfg).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = ModelConfig(
+        name="tiny-mixtral", vocab_size=128, dim=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, head_dim=16, mlp_hidden=112, rope_theta=10000.0,
+        rms_eps=1e-5, n_experts=4, experts_per_token=2,
+    )
+    params = convert_hf_checkpoint(cfg, tmp_path, dtype=jnp.float32)
+
+    token_ids = [1, 3, 64, 99, 12, 7]
+    with torch.no_grad():
+        hf_logits = model(torch.tensor([token_ids])).logits[0].numpy()
+    assert_logit_parity(hf_logits, run_ours(cfg, params, token_ids))
+
+
+def test_conversion_shape_mismatch_rejected(tmp_path):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+    )
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    bad_cfg = ModelConfig(
+        name="bad", vocab_size=128, dim=64, n_layers=2, n_heads=8,  # wrong heads
+        n_kv_heads=2, head_dim=16, mlp_hidden=176,
+    )
+    with pytest.raises(ValueError, match="mismatch"):
+        convert_hf_checkpoint(bad_cfg, tmp_path, dtype=jnp.float32)

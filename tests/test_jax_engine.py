@@ -1,0 +1,151 @@
+"""JaxEngine tests: generation mechanics end-to-end on CPU with the toy
+model + byte tokenizer (SURVEY.md §7 step 3 — the minimum end-to-end
+slice, minus real weights)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ai_agent_kubectl_tpu.engine.jax_engine import JaxEngine
+from ai_agent_kubectl_tpu.engine.protocol import EngineResult
+from ai_agent_kubectl_tpu.models.config import get_config
+
+
+@pytest.fixture(scope="module")
+def engine():
+    import asyncio
+
+    eng = JaxEngine(
+        get_config("toy-8m"),
+        dtype="float32",
+        max_seq_len=256,
+        prefill_buckets=(64, 128),
+        seed=0,
+    )
+    asyncio.run(eng.start())
+    return eng
+
+
+async def test_generate_mechanics(engine):
+    result = await engine.generate("list all pods", max_tokens=8)
+    assert isinstance(result, EngineResult)
+    assert result.prompt_tokens > 0
+    assert 0 <= result.completion_tokens <= 8
+    assert result.prefill_ms > 0 and result.ttft_ms > 0
+    assert result.engine == "jax"
+    assert result.finish_reason in ("stop", "length")
+
+
+async def test_greedy_determinism(engine):
+    # temperature=0 (reference parity, app.py:109) must be reproducible.
+    r1 = await engine.generate("show me the nodes", max_tokens=6, temperature=0.0)
+    r2 = await engine.generate("show me the nodes", max_tokens=6, temperature=0.0)
+    assert r1.text == r2.text
+
+
+async def test_stream_matches_generate(engine):
+    pieces = []
+    async for piece in engine.generate_stream("get deployments", max_tokens=6):
+        pieces.append(piece)
+    full = await engine.generate("get deployments", max_tokens=6)
+    assert "".join(pieces) == full.text
+
+
+async def test_bucket_selection(engine):
+    assert engine._bucket_for(10) == 64
+    assert engine._bucket_for(64) == 64
+    assert engine._bucket_for(65) == 128
+    with pytest.raises(ValueError):
+        engine._bucket_for(1000)
+
+
+async def test_long_prompt_truncated_not_crashing(engine):
+    # Prompts longer than the biggest bucket are left-truncated.
+    result = await engine.generate("x" * 500, max_tokens=4)
+    assert result.prompt_tokens <= 128
+
+
+async def test_engine_not_started_raises():
+    from ai_agent_kubectl_tpu.engine.protocol import EngineUnavailable
+
+    eng = JaxEngine(get_config("toy-8m"), dtype="float32", max_seq_len=64,
+                    prefill_buckets=(32,))
+    with pytest.raises(EngineUnavailable):
+        await eng.generate("hello there")
+
+
+async def test_served_through_http():
+    """Full slice: HTTP → service → JaxEngine → toy model → response.
+
+    A random-init toy model emits arbitrary bytes, so the valid outcomes
+    are 200 (lucky valid command) or 422 (safety validator caught it) —
+    both prove the whole path executed.
+    """
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ai_agent_kubectl_tpu.config import ServiceConfig
+    from ai_agent_kubectl_tpu.server.app import create_app
+
+    cfg = ServiceConfig(
+        engine="jax", model_name="toy-8m", dtype="float32",
+        max_seq_len=256, prefill_buckets="64,128", max_new_tokens=8,
+    )
+    eng = JaxEngine(
+        get_config("toy-8m"), dtype="float32", max_seq_len=256,
+        prefill_buckets=(64, 128),
+    )
+    app = create_app(cfg, eng)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post("/kubectl-command", json={"query": "list all pods"})
+        assert resp.status in (200, 422)
+        health = await (await client.get("/health")).json()
+        assert health["engine"] == "jax" and health["engine_ready"] is True
+    finally:
+        await client.close()
+
+
+async def test_stream_utf8_multibyte_not_corrupted(engine):
+    # A token boundary mid-way through a multi-byte character must not leak
+    # U+FFFD into the stream (code-review regression).
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    ids = tok.encode("é✓", add_bos=False)
+    assert len(ids) > 2  # multi-byte chars split across byte tokens
+
+    # Drive the incremental detok logic directly through a scripted decode:
+    # emulate by streaming from the real engine and checking no '�'
+    # appears in pieces unless it is in the final text too.
+    pieces = []
+    async for piece in engine.generate_stream("describe pod web", max_tokens=8):
+        pieces.append(piece)
+    full = await engine.generate("describe pod web", max_tokens=8)
+    assert "".join(pieces) == full.text
+
+
+async def test_max_tokens_clamped_to_cache(engine):
+    # MAX_NEW_TOKENS >= MAX_SEQ_LEN must not overflow the KV cache
+    # (code-review regression: falsy-zero max_prompt).
+    result = await engine.generate("list pods", max_tokens=10_000)
+    assert result.completion_tokens < engine.max_seq_len
+
+
+async def test_stream_cancellation_releases_engine(engine):
+    # Cancelling a stream mid-generation must not wedge the engine lock or
+    # raise "generator already executing" (code-review regression).
+    import asyncio
+
+    async def consume_one():
+        agen = engine.generate_stream("show all deployments", max_tokens=64)
+        async for _ in agen:
+            break  # disconnect after the first piece
+        await agen.aclose()
+
+    await asyncio.wait_for(consume_one(), timeout=30)
+    # Engine must still serve the next request.
+    result = await asyncio.wait_for(
+        engine.generate("list pods", max_tokens=4), timeout=30
+    )
+    assert result.engine == "jax"
