@@ -9,9 +9,17 @@ Design:
 - **Bucketed prefill**: prompts are padded to the next bucket length
   (PREFILL_BUCKETS) so jit sees a handful of static shapes; first request
   per bucket pays compilation, everything after hits the cache.
-- **jit decode step**: one token per call, static shapes, KV cache
+- **On-device decode chunks**: the hot loop is a jitted ``lax.scan`` that
+  generates CHUNK tokens (forward + sample) per dispatch, so the host↔device
+  round trip is paid once per chunk, not once per token — critical when the
+  chip sits behind a network tunnel, and still the right design locally
+  (one XLA program, no per-token dispatch overhead). The KV cache is
   donated (``donate_argnums``) so XLA updates it in place in HBM rather
   than copying ~GBs per token.
+- **Speculative chunk pipelining**: the next chunk is dispatched (chained
+  on device arrays, no host read) before the current chunk's tokens are
+  pulled, hiding transfer latency behind compute. On EOS the in-flight
+  chunk is abandoned — wasted FLOPs, never wasted wall-clock.
 - **Blocking JAX work runs on a worker thread** (``asyncio.to_thread``)
   so the event loop keeps serving /health and /metrics during generation;
   an asyncio.Lock serializes requests (the continuous-batching scheduler
@@ -38,8 +46,8 @@ import numpy as np
 from ..models.config import ModelConfig, get_config
 from ..models.transformer import KVCache, forward, init_params
 from .protocol import EngineResult, EngineUnavailable, GenerationTimeout
-from .sampling import sample_token
-from .tokenizer import Tokenizer, load_tokenizer
+from .sampling import sample_token_traced
+from .tokenizer import StreamDecoder, Tokenizer, load_tokenizer
 
 logger = logging.getLogger(__name__)
 
@@ -81,8 +89,14 @@ class JaxEngine:
         self._ready = False
         self._lock: Optional[asyncio.Lock] = None
         self._prefill_fns = {}
-        self._decode_fn = None
-        self._sample_fns = {}
+        self._chunk_fns = {}   # chunk_len -> jitted decode chunk
+        self._sample_fn = jax.jit(sample_token_traced)
+
+    #: decode chunk sizes (tokens per device dispatch), largest first. The
+    #: scheduler greedily decomposes the remaining budget over these, so a
+    #: 20-token request runs 8+8+1+1+1+1 rather than a 32-step chunk whose
+    #: tail it would block on and throw away.
+    CHUNK_SIZES = (32, 8, 1)
 
     @classmethod
     def from_config(cls, cfg) -> "JaxEngine":
@@ -134,12 +148,6 @@ class JaxEngine:
             return forward(params, cfg, tokens, positions, cache,
                            kv_limit=kv_limit, attn_impl=self.attn_impl)
 
-        def decode_step(params, tokens, positions, cache):
-            return forward(params, cfg, tokens, positions, cache,
-                           kv_limit=self.max_seq_len, attn_impl="dense")
-
-        # Donate the cache so decode updates KV in place in HBM.
-        self._decode_fn = jax.jit(decode_step, donate_argnums=(3,))
         for b in self.prefill_buckets:
             self._prefill_fns[b] = jax.jit(
                 partial(prefill, kv_limit=b), donate_argnums=(3,)
@@ -155,8 +163,20 @@ class JaxEngine:
         _, cache = self._prefill_fns[b](self.params, tokens, positions, cache)
         step_tokens = jnp.zeros((1, 1), jnp.int32)
         step_pos = jnp.full((1, 1), b, jnp.int32)
-        logits, _ = self._decode_fn(self.params, step_tokens, step_pos, cache)
-        logits.block_until_ready()
+        key = jax.random.PRNGKey(0)
+        # Warm every chunk size (temperature is traced — one compile per
+        # size serves all temperatures, so no first-request compile stall).
+        temp0 = jnp.asarray(0.0, jnp.float32)
+        for chunk_len in self.CHUNK_SIZES:
+            fn = self._get_chunk_fn(chunk_len)
+            toks, _, _, cache, _, _ = fn(self.params, step_tokens, step_pos,
+                                         cache, key, temp0,
+                                         jnp.asarray(False))
+        # Warm the first-token sampler too — it sits on the TTFT path.
+        self._sample_fn(
+            jnp.zeros((1, cfg.vocab_size), jnp.float32), key, temp0
+        ).block_until_ready()
+        toks.block_until_ready()
         logger.info(
             "Engine ready: %s (%.1fM params, %s, buckets=%s) in %.1fs",
             cfg.name, cfg.param_count() / 1e6, np.dtype(self.dtype).name,
@@ -176,6 +196,64 @@ class JaxEngine:
             f"Prompt of {n} tokens exceeds the largest prefill bucket "
             f"{self.prefill_buckets[-1]}"
         )
+
+    def _get_chunk_fn(self, chunk_len: int):
+        """Jitted on-device decode chunk: ``lax.scan`` over ``chunk_len``
+        steps (forward one token → sample next), cache donated.
+
+        - **EOS chunk-skip on device**: the scan runs under a ``lax.cond``
+          on the incoming ``done`` flag, and ``done`` is recomputed from the
+          chunk's outputs — so a speculatively-dispatched chunk that follows
+          an EOS costs ~nothing, while the active path keeps full ``scan``
+          speed (a dynamic-trip-count ``while_loop`` here measured ~40%
+          slower: it defeats XLA's cross-iteration pipelining).
+        - **Temperature is traced** (sampling.sample_token_traced): one
+          compile per chunk length serves every temperature.
+
+        Returns ``(toks [B, T] (all -1 when skipped), tok [B,1], pos [B,1],
+        cache, key, done)``. Tokens after a mid-chunk EOS are garbage the
+        host discards — only the cross-chunk ``done`` flag matters.
+
+        Single-sequence only (B == 1, asserted at trace time): ``done`` is a
+        scalar, so a batched caller would have one sequence's EOS cancel the
+        whole batch. The continuous-batching scheduler has its own step fn
+        with per-slot done masking."""
+        fn = self._chunk_fns.get(chunk_len)
+        if fn is not None:
+            return fn
+        cfg = self.model_cfg
+        eos_arr = jnp.asarray(cfg.eos_ids, jnp.int32)
+
+        def decode_chunk(params, tok, pos, cache, key, temperature, done):
+            assert tok.shape[0] == 1, "chunk fn is single-sequence (B==1)"
+            def run(operand):
+                tok, pos, cache, key = operand
+
+                def body(carry, _):
+                    tok, pos, cache, key = carry
+                    logits, cache = forward(params, cfg, tok, pos, cache,
+                                            kv_limit=self.max_seq_len,
+                                            attn_impl="dense")
+                    key, sub = jax.random.split(key)
+                    nxt = sample_token_traced(logits[:, 0], sub, temperature)
+                    return (nxt[:, None], pos + 1, cache, key), nxt
+
+                (tok, pos, cache, key), toks = jax.lax.scan(
+                    body, (tok, pos, cache, key), None, length=chunk_len
+                )
+                new_done = jnp.any(toks[..., None] == eos_arr)
+                return jnp.swapaxes(toks, 0, 1), tok, pos, cache, key, new_done
+
+            def skip(operand):
+                tok, pos, cache, key = operand
+                toks = jnp.full((tok.shape[0], chunk_len), -1, jnp.int32)
+                return toks, tok, pos, cache, key, jnp.asarray(True)
+
+            return jax.lax.cond(done, skip, run, (tok, pos, cache, key))
+
+        fn = jax.jit(decode_chunk, donate_argnums=(3,))
+        self._chunk_fns[chunk_len] = fn
+        return fn
 
     def _generate_blocking(self, prompt: str, max_tokens: int,
                            temperature: float, deadline: Optional[float],
@@ -219,70 +297,109 @@ class JaxEngine:
         last_logits = logits[:, n_prompt - 1]
 
         key = jax.random.PRNGKey(self.seed + n_prompt)
-        # One cached jit wrapper per temperature (a fresh jax.jit per request
-        # would recompile every time).
-        sample = self._sample_fns.get(temperature)
-        if sample is None:
-            sample = self._sample_fns[temperature] = jax.jit(
-                partial(sample_token, temperature=temperature)
-            )
+        key, chunk_key = jax.random.split(key)
+        temp_d = jnp.asarray(temperature, jnp.float32)
 
-        generated: list[int] = []
+        detok = StreamDecoder(self.tokenizer)  # detok.ids = generated tokens
         t_first = None
         t_decode0 = time.monotonic()
         prefill_ms = (t_decode0 - t_prefill0) * 1000.0
-
-        next_tok = sample(last_logits, key)
-        pos = n_prompt
         finish = "length"
-        text = ""
-        emitted = 0  # chars of `text` already yielded
-        for i in range(max_tokens):
-            if deadline is not None and time.monotonic() > deadline:
-                raise GenerationTimeout("generation exceeded timeout")
-            if cancel is not None and cancel.is_set():
-                finish = "abort"
-                break
-            tok = int(next_tok[0])
-            if t_first is None:
-                t_first = time.monotonic()
-            if tok in cfg.eos_ids:
-                finish = "stop"
-                break
-            generated.append(tok)
-            # Incremental detokenization. A token can end mid-way through a
-            # multi-byte UTF-8 character (decode() shows U+FFFD); hold back
-            # trailing replacement chars until the next token resolves them,
-            # else the stream diverges from the final text.
-            text = self.tokenizer.decode(generated)
-            stable = len(text)
-            while stable > emitted and text[stable - 1] == "�" and len(text) - stable < 3:
-                stable -= 1
-            if stable > emitted:
-                yield ("token", text[emitted:stable])
-                emitted = stable
-            if i == max_tokens - 1:
-                break
-            key, subkey = jax.random.split(key)
-            step_logits, cache = self._decode_fn(
-                self.params,
-                jnp.asarray([[tok]], jnp.int32),
-                jnp.asarray([[pos]], jnp.int32),
-                cache,
-            )
-            next_tok = sample(step_logits[:, 0], subkey)
-            pos += 1
 
-        if emitted < len(text):
-            # Flush any held-back tail (genuinely invalid bytes stay U+FFFD).
-            yield ("token", text[emitted:])
+        # First token: sampled from the prefill logits, pulled to host
+        # immediately — this IS time-to-first-token.
+        next_tok = self._sample_fn(last_logits, key, temp_d)
+        first_id = int(next_tok[0])
+        t_first = time.monotonic()
+        stopped = False
+        if first_id in cfg.eos_ids:
+            finish = "stop"
+            stopped = True
+        else:
+            piece = detok.push(first_id)
+            if piece is not None:
+                yield ("token", piece)
+            if max_tokens <= 1:
+                stopped = True
+
+        # Hot loop: on-device decode chunks, pipelined two deep. Each chunk
+        # is one dispatch; the next chunk is chained on device arrays before
+        # the current one's tokens are pulled, so transfer latency (large
+        # behind a tunnel) overlaps device compute. Chunk sizes greedily
+        # decompose the remaining budget (CHUNK_SIZES) — never overshooting
+        # max_tokens or the KV capacity, so an early-EOS abandon wastes at
+        # most one in-flight chunk.
+        if not stopped:
+            from collections import deque
+
+            tok_d = next_tok[:, None].astype(jnp.int32)
+            pos_d = jnp.full((1, 1), n_prompt, jnp.int32)
+            key_d = chunk_key
+            done_d = jnp.asarray(False)
+            budget = max_tokens - len(detok.ids)
+            sched = 0                # tokens scheduled via chunks
+            sched_pos = n_prompt     # KV slot the next chunk writes first
+            inflight: deque = deque()
+
+            while True:
+                while len(inflight) < 2 and sched < budget:
+                    chunk_len = next(
+                        (s for s in self.CHUNK_SIZES
+                         if s <= budget - sched
+                         and sched_pos + s <= self.max_seq_len),
+                        0,
+                    )
+                    if chunk_len == 0:
+                        break  # KV capacity exhausted
+                    fn = self._get_chunk_fn(chunk_len)
+                    toks_d, tok_d, pos_d, cache, key_d, done_d = fn(
+                        self.params, tok_d, pos_d, cache, key_d, temp_d, done_d
+                    )
+                    inflight.append(toks_d)
+                    sched += chunk_len
+                    sched_pos += chunk_len
+                if not inflight:
+                    break
+                # Deadline/cancel granularity is one chunk (≤ CHUNK_SIZES[0]
+                # token-steps): a timeout or disconnect can overshoot by at
+                # most one chunk's decode time — the price of keeping the
+                # hot loop on-device.
+                if deadline is not None and time.monotonic() > deadline:
+                    raise GenerationTimeout("generation exceeded timeout")
+                if cancel is not None and cancel.is_set():
+                    finish = "abort"
+                    break
+                chunk_ids = np.asarray(inflight.popleft())[0]
+                new_ids = []
+                for tid in chunk_ids:
+                    tid = int(tid)
+                    if tid < 0:  # early-exit padding: chunk ended at EOS
+                        break
+                    if tid in cfg.eos_ids:
+                        finish = "stop"
+                        stopped = True
+                        break
+                    new_ids.append(tid)
+                    if len(detok.ids) + len(new_ids) >= max_tokens:
+                        stopped = True
+                        break
+                piece = detok.push(*new_ids) if new_ids else None
+                if piece is not None:
+                    yield ("token", piece)
+                if stopped:
+                    break
+
+        # Flush any held-back tail (genuinely invalid bytes stay U+FFFD).
+        piece = detok.flush()
+        if piece is not None:
+            yield ("token", piece)
 
         t_end = time.monotonic()
         decode_ms = (t_end - t_decode0) * 1000.0
         result = EngineResult(
-            text=text,
+            text=detok.text,
             prompt_tokens=n_prompt,
-            completion_tokens=len(generated),
+            completion_tokens=len(detok.ids),
             prefill_ms=prefill_ms,
             decode_ms=decode_ms,
             ttft_ms=((t_first or t_end) - t_start) * 1000.0,

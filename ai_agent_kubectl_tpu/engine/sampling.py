@@ -1,42 +1,51 @@
 """Token sampling: greedy, temperature, top-k, top-p.
 
 temperature=0 → greedy argmax, matching the reference's deterministic
-``temperature=0`` LLM setup (app.py:109). All ops are jit-compatible
-(static shapes, no data-dependent control flow).
+``temperature=0`` LLM setup (app.py:109). Temperature is a *traced* scalar
+so one compiled program serves every value (no per-float jit-cache growth,
+no mid-request compile stalls); top-k/top-p are static hyperparameters
+(changing them recompiles, which is the right trade — they are service
+config, not per-request values).
 """
 
 from __future__ import annotations
-
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 
-def sample_token(
-    logits: jnp.ndarray,           # [batch, vocab] f32
+def sample_token_traced(
+    logits: jnp.ndarray,            # [batch, vocab] f32
     key: jax.Array,
-    temperature: float = 0.0,
+    temperature: jnp.ndarray,       # traced scalar
     top_k: int = 0,
     top_p: float = 1.0,
 ) -> jnp.ndarray:
-    """Sample next token ids [batch]. Static hyperparameters → one compile
-    per sampling config."""
-    if temperature <= 0.0:
+    """Sample next token ids [batch]. ``lax.cond`` executes only the taken
+    branch — the greedy path never pays gumbel-noise generation over the
+    vocab, and the sampled path applies top-k then top-p filtering."""
+
+    def _greedy(_):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    logits = logits / temperature
-    if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cumprobs = jnp.cumsum(probs, axis=-1)
-        # Keep the smallest set with cumulative prob >= top_p (always keep 1).
-        cutoff_mask = cumprobs - probs >= top_p
-        cutoff_logit = jnp.min(
-            jnp.where(cutoff_mask, jnp.inf, sorted_logits), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    def _sampled(_):
+        t = jnp.maximum(temperature, 1e-6)
+        scaled = logits / t
+        if top_k > 0:
+            kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        if top_p < 1.0:
+            sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cumprobs = jnp.cumsum(probs, axis=-1)
+            # Keep the smallest set with cumulative prob >= top_p (always
+            # keep at least one token).
+            cutoff_mask = cumprobs - probs >= top_p
+            cutoff_logit = jnp.min(
+                jnp.where(cutoff_mask, jnp.inf, sorted_logits),
+                axis=-1, keepdims=True,
+            )
+            scaled = jnp.where(scaled < cutoff_logit, -jnp.inf, scaled)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    return jax.lax.cond(temperature > 0.0, _sampled, _greedy, None)

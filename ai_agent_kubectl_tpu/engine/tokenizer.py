@@ -74,6 +74,46 @@ class HFTokenizer:
         return self._tok.decode([i for i in ids if i not in specials])
 
 
+class StreamDecoder:
+    """Incremental detokenization with UTF-8 hold-back.
+
+    A token can end mid-way through a multi-byte UTF-8 character, where
+    ``decode()`` shows U+FFFD; trailing replacement chars are held back
+    until the next token resolves them, so streamed pieces concatenate to
+    exactly the final text with no transient mojibake. Genuinely invalid
+    bytes (still U+FFFD after 3 more chars arrive) are released by
+    ``push``; ``flush`` emits any held-back tail at end of stream.
+    """
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tokenizer = tokenizer
+        self.ids: List[int] = []
+        self.text = ""
+        self._emitted = 0
+
+    def push(self, *new_ids: int) -> Optional[str]:
+        """Add token ids; return the newly-stable text piece (or None)."""
+        self.ids.extend(new_ids)
+        self.text = self._tokenizer.decode(self.ids)
+        stable = len(self.text)
+        while (stable > self._emitted and self.text[stable - 1] == "�"
+               and len(self.text) - stable < 3):
+            stable -= 1
+        if stable > self._emitted:
+            piece = self.text[self._emitted:stable]
+            self._emitted = stable
+            return piece
+        return None
+
+    def flush(self) -> Optional[str]:
+        """Emit any held-back tail (end of stream)."""
+        if self._emitted < len(self.text):
+            piece = self.text[self._emitted:]
+            self._emitted = len(self.text)
+            return piece
+        return None
+
+
 def load_tokenizer(model_cfg, tokenizer_path: Optional[str]) -> Tokenizer:
     """Pick the tokenizer for a model config: HF file when provided/found,
     byte-level for toy models."""

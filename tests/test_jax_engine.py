@@ -106,23 +106,42 @@ async def test_served_through_http():
         await client.close()
 
 
-async def test_stream_utf8_multibyte_not_corrupted(engine):
+def test_stream_decoder_holds_back_split_multibyte():
     # A token boundary mid-way through a multi-byte character must not leak
-    # U+FFFD into the stream (code-review regression).
-    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
+    # U+FFFD into the stream (code-review regression). ByteTokenizer makes
+    # every byte its own token, so 'é' (2 bytes) and '✓' (3 bytes) are
+    # guaranteed to split across pushes.
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder
 
     tok = ByteTokenizer()
-    ids = tok.encode("é✓", add_bos=False)
-    assert len(ids) > 2  # multi-byte chars split across byte tokens
+    ids = tok.encode("é✓x", add_bos=False)
+    assert len(ids) == 6  # 2 + 3 + 1 bytes
 
-    # Drive the incremental detok logic directly through a scripted decode:
-    # emulate by streaming from the real engine and checking no '�'
-    # appears in pieces unless it is in the final text too.
+    detok = StreamDecoder(tok)
+    pieces = [p for i in ids if (p := detok.push(i)) is not None]
+    tail = detok.flush()
+    if tail is not None:
+        pieces.append(tail)
+    assert all("�" not in p for p in pieces), pieces
+    assert "".join(pieces) == "é✓x"
+
+
+def test_stream_decoder_releases_genuinely_invalid_bytes():
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder
+
+    tok = ByteTokenizer()
+    detok = StreamDecoder(tok)
+    # 0xFF is never valid UTF-8; after 3 following chars it must be released
+    # as U+FFFD rather than held back forever.
     pieces = []
-    async for piece in engine.generate_stream("describe pod web", max_tokens=8):
-        pieces.append(piece)
-    full = await engine.generate("describe pod web", max_tokens=8)
-    assert "".join(pieces) == full.text
+    for i in [0xFF + 3] + tok.encode("abcd", add_bos=False):
+        p = detok.push(i)
+        if p is not None:
+            pieces.append(p)
+    tail = detok.flush()
+    if tail is not None:
+        pieces.append(tail)
+    assert "".join(pieces) == "�abcd"
 
 
 async def test_max_tokens_clamped_to_cache(engine):

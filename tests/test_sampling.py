@@ -1,0 +1,65 @@
+"""Sampling unit tests: greedy parity, determinism, top-k/top-p filtering."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai_agent_kubectl_tpu.engine.sampling import sample_token_traced
+
+
+def _logits():
+    # Batch of 2, vocab of 8 with a clear ranking.
+    return jnp.asarray([
+        [0.1, 5.0, 0.2, 0.3, 4.0, 0.0, -1.0, 3.0],
+        [2.0, 0.0, 6.0, 1.0, 0.5, 0.2, 0.1, -2.0],
+    ], jnp.float32)
+
+
+def test_greedy_is_argmax_regardless_of_key():
+    logits = _logits()
+    t0 = jnp.asarray(0.0, jnp.float32)
+    for seed in range(3):
+        out = sample_token_traced(logits, jax.random.PRNGKey(seed), t0)
+        np.testing.assert_array_equal(np.asarray(out), [1, 2])
+
+
+def test_one_compile_serves_all_temperatures():
+    logits = _logits()
+    fn = jax.jit(sample_token_traced)
+    key = jax.random.PRNGKey(0)
+    fn(logits, key, jnp.asarray(0.0, jnp.float32))
+    n_compiles = fn._cache_size()
+    fn(logits, key, jnp.asarray(0.7, jnp.float32))
+    fn(logits, key, jnp.asarray(1.3, jnp.float32))
+    assert fn._cache_size() == n_compiles
+
+
+def test_sampled_is_deterministic_per_key():
+    logits = _logits()
+    t = jnp.asarray(0.8, jnp.float32)
+    key = jax.random.PRNGKey(42)
+    a = sample_token_traced(logits, key, t)
+    b = sample_token_traced(logits, key, t)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_top_k_restricts_support():
+    logits = _logits()
+    t = jnp.asarray(5.0, jnp.float32)  # hot — spreads mass widely
+    allowed = {(0, 1), (0, 4), (1, 2), (1, 0)}  # top-2 per row
+    for seed in range(20):
+        out = np.asarray(sample_token_traced(
+            logits, jax.random.PRNGKey(seed), t, top_k=2
+        ))
+        assert (0, out[0]) in allowed and (1, out[1]) in allowed
+
+
+def test_top_p_always_keeps_best_token():
+    logits = _logits()
+    t = jnp.asarray(1.0, jnp.float32)
+    for seed in range(10):
+        out = np.asarray(sample_token_traced(
+            logits, jax.random.PRNGKey(seed), t, top_p=1e-6
+        ))
+        # top_p ~ 0 keeps only the argmax.
+        np.testing.assert_array_equal(out, [1, 2])
