@@ -131,6 +131,7 @@ class ServiceConfig:
     decode_batch_size: int = 8              # DECODE_BATCH_SIZE (continuous batching slots)
     prefill_buckets: str = "64,128,256,512,1024"  # PREFILL_BUCKETS (padded prefill shapes)
     temperature: float = 0.0                # TEMPERATURE (0 == greedy, matches app.py:109)
+    attn_impl: str = "auto"                 # ATTN_IMPL: auto | dense | flash (prefill kernel)
     kv_page_size: int = 16                  # KV_PAGE_SIZE (paged attention)
     hbm_prefix_cache: bool = True           # HBM_PREFIX_CACHE (system-prompt prefix KV)
 
@@ -189,6 +190,7 @@ class ServiceConfig:
             decode_batch_size=_env_int("DECODE_BATCH_SIZE", 8),
             prefill_buckets=_env_str("PREFILL_BUCKETS", "64,128,256,512,1024"),
             temperature=_env_float("TEMPERATURE", 0.0),
+            attn_impl=(_env_str("ATTN_IMPL", "auto") or "auto").lower(),
             kv_page_size=_env_int("KV_PAGE_SIZE", 16),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
             mesh_shape=_env_str("MESH_SHAPE", "") or "",

@@ -70,7 +70,7 @@ class JaxEngine:
         dtype: str = "bfloat16",
         max_seq_len: int = 1024,
         prefill_buckets: tuple = (64, 128, 256, 512, 1024),
-        attn_impl: str = "dense",
+        attn_impl: str = "auto",
         seed: int = 0,
     ):
         self.model_cfg = model_cfg
@@ -81,6 +81,14 @@ class JaxEngine:
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= self.max_seq_len
         ) or (self.max_seq_len,)
+        if attn_impl not in ("auto", "dense", "flash"):
+            raise ValueError(
+                f"ATTN_IMPL must be auto|dense|flash, got {attn_impl!r}"
+            )
+        if attn_impl == "auto":
+            # Flash avoids materializing S×S logits in HBM; prefer it on
+            # TPU. Off-TPU the kernel would run interpreted — use XLA dense.
+            attn_impl = "flash" if jax.default_backend() == "tpu" else "dense"
         self.attn_impl = attn_impl
         self.seed = seed
 
@@ -108,6 +116,7 @@ class JaxEngine:
             dtype=cfg.dtype,
             max_seq_len=cfg.max_seq_len,
             prefill_buckets=cfg.prefill_bucket_list,
+            attn_impl=cfg.attn_impl,
         )
 
     # ------------------------------------------------------------ startup
@@ -144,13 +153,24 @@ class JaxEngine:
 
         cfg = self.model_cfg
 
-        def prefill(params, tokens, positions, cache, *, kv_limit):
+        def prefill(params, tokens, positions, cache, *, kv_limit, impl):
             return forward(params, cfg, tokens, positions, cache,
-                           kv_limit=kv_limit, attn_impl=self.attn_impl)
+                           kv_limit=kv_limit, attn_impl=impl)
+
+        from ..ops.flash_attention import flash_supported
 
         for b in self.prefill_buckets:
+            # Per-bucket fallback: a bucket the flash kernel can't tile
+            # (e.g. PREFILL_BUCKETS=192 or head_dim 64) serves dense while
+            # eligible buckets keep the flash path.
+            impl = self.attn_impl
+            if impl == "flash" and not flash_supported(b, b, cfg.head_dim):
+                logger.warning(
+                    "Bucket %d: shapes not flash-tileable, using dense", b
+                )
+                impl = "dense"
             self._prefill_fns[b] = jax.jit(
-                partial(prefill, kv_limit=b), donate_argnums=(3,)
+                partial(prefill, kv_limit=b, impl=impl), donate_argnums=(3,)
             )
 
         # Warm-up compile on the smallest bucket so the first request

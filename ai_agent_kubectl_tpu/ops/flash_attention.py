@@ -1,0 +1,178 @@
+"""Pallas flash attention (prefill path).
+
+Replaces the remote forward pass of the reference (app.py:184 delegates all
+attention to OpenAI's servers; SURVEY.md §2.2 lists this kernel as a
+first-class build target). TPU-first design, not a CUDA port:
+
+- Grid over ``(batch, q_head, q_block)``; each program holds one q tile and
+  the full KV context for its head in VMEM (prefill contexts are bucket-
+  sized, ≤ a few thousand positions — well within the ~16 MB of VMEM; truly
+  long sequences go through ring attention, parallel/ring_attention.py).
+- Inner ``fori_loop`` over KV tiles with online softmax (running max m,
+  normalizer l, accumulator acc) — one pass over KV, no S×S logits in HBM.
+- **Causal block skipping**: the loop's trip count is computed from the max
+  query position in the tile, so KV tiles that are entirely in the future
+  are never read or multiplied. This is the flash-attention analog of the
+  reference's "don't do work you'll mask away" — for causal prefill it
+  halves the FLOPs.
+- GQA/MQA via the k/v BlockSpec index map (``q_head // q_per_kv``) — no
+  materialized head repetition (ops/attention.py repeats KV heads; here
+  the systolic array just reads the shared tile).
+- Masking uses *absolute* positions per query row, so prefix-KV splicing
+  (cache slots written at absolute positions) is correct by construction.
+
+Interpret mode (`interpret=True`, auto-selected off-TPU) runs the same
+kernel through the Pallas interpreter for CPU tests (SURVEY.md §4 kernel
+unit tests vs the dense reference).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU too, but guard for safety
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _auto_block(dim: int, cap: int) -> Optional[int]:
+    """Largest power-of-two divisor of ``dim``, capped at ``cap``; None if
+    no divisor ≥ 8 exists (Mosaic's minimum sublane tile)."""
+    b = dim & -dim  # largest power of two dividing dim
+    b = min(b, cap)
+    return b if b >= 8 else None
+
+
+def flash_supported(seq_len: int, kv_len: int, head_dim: int) -> bool:
+    """Whether the compiled (non-interpret) kernel can serve these shapes:
+    head_dim must fill MXU lanes; seq/kv need a pow2 tile ≥ 8."""
+    return (
+        head_dim % 128 == 0
+        and _auto_block(seq_len, 128) is not None
+        and _auto_block(kv_len, 128) is not None
+    )
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, pos_ref, o_ref, *, block_k: int,
+                  scale: float, logit_softcap: float):
+    """One (batch, head, q-tile) program: online-softmax over KV tiles.
+
+    Refs are [B, H, S, hd]-laid-out blocks (the wrapper transposes) so the
+    trailing block dims are (seq, head_dim) — the (÷8, ÷128) tiling Mosaic
+    requires."""
+    bq = q_ref.shape[2]
+    hd = q_ref.shape[3]
+    # Keep q/k/v in their storage dtype (bf16) for the dots: the MXU takes
+    # bf16 inputs with f32 accumulation (preferred_element_type) at full
+    # rate; upcasting first would force the ~4x-slower f32 MXU mode.
+    q = q_ref[0, 0, :, :]                                      # [bq, hd]
+    qpos = pos_ref[0, :, :]                                    # [bq, 1] int32
+
+    # Only KV tiles that intersect the causal window [0, max(qpos)] matter.
+    n_blocks = jnp.max(qpos) // block_k + 1
+
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, hd), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(i * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                              # [bq, bk] f32
+        if logit_softcap > 0.0:
+            s = jnp.tanh(s / logit_softcap) * logit_softcap
+        kv_ids = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1
+        )
+        mask = kv_ids <= qpos                                  # [bq, bk]
+        s = jnp.where(mask, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        # Fully-masked rows keep m_new == -inf; exp() garbage there is
+        # discarded by the mask select.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.where(m == -jnp.inf, 0.0, jnp.exp(m - m_new))
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)  # all-masked rows output 0, not NaN
+    o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "logit_softcap", "block_q", "block_k",
+                     "interpret"),
+)
+def flash_attention_cached(
+    q: jnp.ndarray,          # [B, S, H, hd]  (post-RoPE)
+    k: jnp.ndarray,          # [B, KVLEN, KV, hd]  (cache slots = abs positions)
+    v: jnp.ndarray,          # [B, KVLEN, KV, hd]
+    positions: jnp.ndarray,  # [B, S] absolute query positions
+    *,
+    scale: Optional[float] = None,
+    logit_softcap: float = 0.0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Causal flash attention over a KV cache region. Returns [B, S, H, hd].
+
+    Semantics match ops/attention.py::dense_attention with mask
+    ``kv_slot <= position`` (models/transformer.py:163-164).
+    """
+    B, S, H, hd = q.shape
+    KVLEN, KV = k.shape[1], k.shape[2]
+    q_per_kv = H // KV
+    if scale is None:
+        scale = hd ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bq = _auto_block(S, block_q)
+    bk = _auto_block(KVLEN, block_k)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"flash attention needs a power-of-two tile ≥ 8 dividing seq {S} "
+            f"and kv {KVLEN}; use flash_supported() to gate, or dense"
+        )
+
+    pos3 = positions.astype(jnp.int32)[..., None]              # [B, S, 1]
+    # [B, S, H, hd] -> [B, H, S, hd] so trailing block dims are (seq, hd);
+    # XLA fuses these transposes into the surrounding projection matmuls.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    kernel = functools.partial(
+        _flash_kernel, block_k=bk, scale=scale, logit_softcap=logit_softcap
+    )
+    grid = (B, H, S // bq)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, KVLEN, hd),
+                         lambda b, h, i: (b, h // q_per_kv, 0, 0)),
+            pl.BlockSpec((1, 1, KVLEN, hd),
+                         lambda b, h, i: (b, h // q_per_kv, 0, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, h, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, pos3)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,143 @@
+"""Flash-attention kernel vs dense reference (SURVEY.md §4: kernel unit
+tests in interpret mode on CPU against ops/attention.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ai_agent_kubectl_tpu.ops.attention import dense_attention
+from ai_agent_kubectl_tpu.ops.flash_attention import flash_attention_cached
+
+
+def _rand(key, shape):
+    return jax.random.normal(key, shape, dtype=jnp.float32)
+
+
+def _ref(q, k, v, positions, logit_softcap=0.0):
+    kv_pos = jnp.arange(k.shape[1])[None, None, :]
+    mask = kv_pos <= positions[:, :, None]
+    return dense_attention(q, k, v, mask, logit_softcap=logit_softcap)
+
+
+@pytest.mark.parametrize(
+    "B,S,KVLEN,H,KV,hd",
+    [
+        (1, 128, 128, 4, 4, 64),    # MHA
+        (2, 128, 256, 4, 2, 64),    # GQA, kv longer than q block
+        (1, 256, 256, 8, 1, 64),    # MQA
+        (2, 64, 64, 4, 2, 128),     # small seq < block_q
+    ],
+)
+def test_matches_dense(B, S, KVLEN, H, KV, hd):
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = _rand(keys[0], (B, S, H, hd))
+    k = _rand(keys[1], (B, KVLEN, KV, hd))
+    v = _rand(keys[2], (B, KVLEN, KV, hd))
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S)).astype(jnp.int32)
+
+    out = flash_attention_cached(q, k, v, positions)
+    ref = _ref(q, k, v, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_offset_positions_prefix_splice():
+    # Queries at absolute positions 37.. (prefix-KV scenario): cache slots
+    # 0..36 hold a cached prefix; mask must include them.
+    B, S, KVLEN, H, KV, hd = 1, 128, 256, 4, 2, 64
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = _rand(keys[0], (B, S, H, hd))
+    k = _rand(keys[1], (B, KVLEN, KV, hd))
+    v = _rand(keys[2], (B, KVLEN, KV, hd))
+    positions = (jnp.broadcast_to(jnp.arange(S), (B, S)) + 37).astype(jnp.int32)
+
+    out = flash_attention_cached(q, k, v, positions)
+    ref = _ref(q, k, v, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_logit_softcap():
+    B, S, KVLEN, H, KV, hd = 1, 128, 128, 2, 2, 64
+    keys = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = _rand(keys[0], (B, S, H, hd)) * 3.0
+    k = _rand(keys[1], (B, KVLEN, KV, hd)) * 3.0
+    v = _rand(keys[2], (B, KVLEN, KV, hd))
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S)).astype(jnp.int32)
+
+    out = flash_attention_cached(q, k, v, positions, logit_softcap=30.0)
+    ref = _ref(q, k, v, positions, logit_softcap=30.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_io():
+    B, S, KVLEN, H, KV, hd = 1, 128, 128, 4, 2, 64
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand(keys[0], (B, S, H, hd)).astype(jnp.bfloat16)
+    k = _rand(keys[1], (B, KVLEN, KV, hd)).astype(jnp.bfloat16)
+    v = _rand(keys[2], (B, KVLEN, KV, hd)).astype(jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S)).astype(jnp.int32)
+
+    out = flash_attention_cached(q, k, v, positions)
+    assert out.dtype == jnp.bfloat16
+    ref = _ref(q, k, v, positions)
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref, dtype=np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_forward_with_flash_impl_matches_dense_impl():
+    # End-to-end through the transformer: attn_impl="flash" == "dense".
+    from ai_agent_kubectl_tpu.models.config import get_config
+    from ai_agent_kubectl_tpu.models.transformer import (
+        KVCache, forward, init_params,
+    )
+
+    cfg = get_config("toy-8m")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    B, S, max_seq = 2, 64, 128
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S)).astype(jnp.int32)
+
+    cache_a = KVCache.zeros(cfg, B, max_seq, dtype=jnp.float32)
+    cache_b = KVCache.zeros(cfg, B, max_seq, dtype=jnp.float32)
+    ref_logits, _ = forward(params, cfg, tokens, positions, cache_a,
+                            kv_limit=64, attn_impl="dense")
+    out_logits, _ = forward(params, cfg, tokens, positions, cache_b,
+                            kv_limit=64, attn_impl="flash")
+    np.testing.assert_allclose(np.asarray(out_logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_supported_gating():
+    from ai_agent_kubectl_tpu.ops.flash_attention import flash_supported
+
+    assert flash_supported(128, 128, 256)
+    assert flash_supported(192, 192, 128)   # pow2 divisor 64 exists
+    assert not flash_supported(128, 128, 64)   # head_dim not MXU-lane tiled
+    assert not flash_supported(100, 128, 128)  # 100 -> pow2 divisor 4 < 8
+
+
+def test_nonmultiple_seq_uses_smaller_tile():
+    # S=192: tiles must drop to 64; result still matches dense.
+    B, S, KVLEN, H, KV, hd = 1, 192, 192, 2, 2, 64
+    keys = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = _rand(keys[0], (B, S, H, hd))
+    k = _rand(keys[1], (B, KVLEN, KV, hd))
+    v = _rand(keys[2], (B, KVLEN, KV, hd))
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S)).astype(jnp.int32)
+    out = flash_attention_cached(q, k, v, positions)
+    ref = _ref(q, k, v, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_engine_rejects_bad_attn_impl():
+    from ai_agent_kubectl_tpu.engine.jax_engine import JaxEngine
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    with pytest.raises(ValueError, match="ATTN_IMPL"):
+        JaxEngine(get_config("toy-8m"), attn_impl="flash-attn")
