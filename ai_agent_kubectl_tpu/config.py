@@ -119,7 +119,10 @@ class ServiceConfig:
     trust_proxy_headers: bool = False       # TRUST_PROXY_HEADERS
 
     # --- engine selection (replaces OPENAI_* block, app.py:34-36) ---
-    engine: str = "jax"                     # ENGINE: jax | fake | openai
+    engine: str = "jax"                     # ENGINE: jax | jax-batched | fake | openai
+                                            #   "jax" serves through the continuous-
+                                            #   batching scheduler when
+                                            #   DECODE_BATCH_SIZE > 1 (the default)
     model_name: str = "toy-8m"              # MODEL_NAME (registry key)
     model_path: Optional[str] = None        # MODEL_PATH (checkpoint dir)
     tokenizer_path: Optional[str] = None    # TOKENIZER_PATH

@@ -1,0 +1,457 @@
+"""Continuous-batching engine: admit-at-chunk scheduling over fixed slots.
+
+The reference serves one request per event-loop await (app.py:183-186, a
+single remote call in flight); BASELINE config 3 requires bs=32 continuous
+batching. TPU-first design (SURVEY.md §7 hard part "continuous batching ×
+jit"):
+
+- **Fixed-capacity decode batch**: a persistent KV cache of
+  ``batch_size`` slots ([L, N, max_seq, KV, hd]) lives in HBM and is
+  donated through every step — jit sees one static shape forever, so there
+  is exactly one compiled decode program regardless of load.
+- **Admit-at-chunk**: decode runs in jitted ``lax.scan`` chunks of
+  ``chunk_len`` tokens for all slots at once (one host round trip per
+  chunk, not per token). Between chunks the scheduler admits queued
+  requests into free slots: prefill into a scratch single-slot cache
+  (B=1, reusing the bucketed prefill programs), then a jitted
+  ``dynamic_update_slice`` splices the KV into the slot. Admission never
+  recompiles anything.
+- **Active-slot masking**: free/finished slots keep decoding garbage into
+  their own dead cache region (positions are frozen via the ``active``
+  mask); their outputs are discarded host-side. Wasted lanes, zero
+  synchronization — the standard static-shape trade.
+- **Per-slot sampling state**: positions, last token, and temperature are
+  device vectors updated by the splice fn; per-slot temperature sampling
+  only pays the categorical cost when some slot is non-greedy.
+
+The scheduler runs on one dedicated worker thread; request coroutines talk
+to it through a thread-safe admission queue and per-request asyncio queues
+(tokens stream back with ``loop.call_soon_threadsafe``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import queue as _queue
+import threading
+import time
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import KVCache, forward
+from .jax_engine import JaxEngine
+from .protocol import EngineResult, EngineUnavailable, GenerationTimeout
+from .sampling import sample_tokens_batched
+from .tokenizer import StreamDecoder
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt_ids: List[int]
+    max_tokens: int
+    temperature: float
+    deadline: Optional[float]
+    loop: asyncio.AbstractEventLoop
+    out_queue: asyncio.Queue
+    cancel: threading.Event
+    t_submit: float
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: _Request
+    detok: StreamDecoder
+    n_prompt: int
+    pos: int                      # scheduled device position (counts dispatched chunks)
+    prefill_ms: float
+    queue_ms: float
+    t_decode0: float
+    t_first: Optional[float] = None
+    chunks_inflight: int = 0      # dispatched-but-unconsumed chunks for this slot
+    exhausted: bool = False       # KV capacity reached; drain pipeline, then finish
+
+
+class BatchedJaxEngine(JaxEngine):
+    """Engine-protocol implementation with continuous batching."""
+
+    name = "jax-batched"
+
+    def __init__(self, *args, batch_size: int = 8, chunk_len: int = 8,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        self.batch_size = batch_size
+        self.chunk_len = chunk_len
+        self._admissions: _queue.Queue = _queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._running = False
+
+    @classmethod
+    def from_config(cls, cfg) -> "BatchedJaxEngine":
+        from ..models.config import get_config
+
+        return cls(
+            get_config(cfg.model_name),
+            model_path=cfg.model_path,
+            tokenizer_path=cfg.tokenizer_path,
+            dtype=cfg.dtype,
+            max_seq_len=cfg.max_seq_len,
+            prefill_buckets=cfg.prefill_bucket_list,
+            attn_impl=cfg.attn_impl,
+            batch_size=cfg.decode_batch_size,
+        )
+
+    # ------------------------------------------------------------ startup
+
+    def _start_blocking(self) -> None:
+        t0 = time.monotonic()
+        self._load()
+        self._build_prefill_fns()
+        cfg = self.model_cfg
+        N, S = self.batch_size, self.max_seq_len
+
+        def batched_chunk(params, tok, pos, cache, key, temps, active):
+            """scan of chunk_len batched decode steps. Inactive slots keep
+            their position (their writes land on a frozen, dead cache slot
+            and their tokens are discarded)."""
+
+            def body(carry, _):
+                tok, pos, cache, key = carry
+                logits, cache = forward(params, cfg, tok, pos, cache,
+                                        kv_limit=S, attn_impl="dense")
+                key, sub = jax.random.split(key)
+                nxt = sample_tokens_batched(logits[:, 0], sub, temps)
+                nxt = jnp.where(active, nxt, tok[:, 0])
+                pos = pos + active.astype(jnp.int32)[:, None]
+                return (nxt[:, None], pos, cache, key), nxt
+
+            (tok, pos, cache, key), toks = jax.lax.scan(
+                body, (tok, pos, cache, key), None, length=self.chunk_len
+            )
+            return jnp.swapaxes(toks, 0, 1), tok, pos, cache, key
+
+        self._chunk_fn = jax.jit(batched_chunk, donate_argnums=(1, 2, 3))
+
+        def splice(cache, src_k, src_v, tok, pos, temps,
+                   slot, n_prompt, first_tok, temperature):
+            """Insert a prefilled request into slot ``slot``."""
+            k = jax.lax.dynamic_update_slice(cache.k, src_k, (0, slot, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(cache.v, src_v, (0, slot, 0, 0, 0))
+            lengths = cache.lengths.at[slot].set(n_prompt)
+            tok = tok.at[slot, 0].set(first_tok)
+            pos = pos.at[slot, 0].set(n_prompt)
+            temps = temps.at[slot].set(temperature)
+            return KVCache(k=k, v=v, lengths=lengths), tok, pos, temps
+
+        self._splice_fn = jax.jit(splice, donate_argnums=(0, 3, 4, 5))
+
+        # Device-side scheduler state.
+        self._cache = KVCache.zeros(cfg, N, S, dtype=self.dtype)
+        self._tok_d = jnp.zeros((N, 1), jnp.int32)
+        self._pos_d = jnp.zeros((N, 1), jnp.int32)
+        self._temps_d = jnp.zeros((N,), jnp.float32)
+        self._key_d = jax.random.PRNGKey(self.seed)
+        self._slots: List[Optional[_Slot]] = [None] * N
+
+        # Warm-up: smallest prefill bucket + the decode chunk + splice.
+        b = self.prefill_buckets[0]
+        scratch = KVCache.zeros(cfg, 1, S, dtype=self.dtype)
+        logits, scratch = self._prefill_fns[b](
+            self.params,
+            jnp.zeros((1, b), jnp.int32),
+            jnp.broadcast_to(jnp.arange(b), (1, b)).astype(jnp.int32),
+            scratch,
+        )
+        self._sample_fn(
+            jnp.zeros((1, cfg.vocab_size), jnp.float32), self._key_d,
+            jnp.asarray(0.0, jnp.float32),
+        )
+        self._cache, self._tok_d, self._pos_d, self._temps_d = self._splice_fn(
+            self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
+            self._temps_d, jnp.asarray(0, jnp.int32),
+            jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(0.0, jnp.float32),
+        )
+        toks, self._tok_d, self._pos_d, self._cache, self._key_d = (
+            self._chunk_fn(self.params, self._tok_d, self._pos_d, self._cache,
+                           self._key_d, self._temps_d,
+                           jnp.zeros((N,), jnp.bool_))
+        )
+        toks.block_until_ready()
+
+        self._running = True
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="batch-scheduler", daemon=True
+        )
+        self._worker.start()
+        logger.info(
+            "Batched engine ready: %s ×%d slots, chunk=%d, %.1fs",
+            cfg.name, N, self.chunk_len, time.monotonic() - t0,
+        )
+
+    async def stop(self) -> None:
+        self._ready = False
+        self._running = False
+        if self._worker is not None:
+            await asyncio.to_thread(self._worker.join, 10.0)
+            self._worker = None
+
+    # ---------------------------------------------------------- scheduler
+
+    def _worker_loop(self) -> None:
+        # Chunk pipeline, two deep: dispatch chunk N+1 (chained on device
+        # arrays) before pulling chunk N's tokens, so the host↔device round
+        # trip overlaps decode compute. Each in-flight chunk carries a
+        # snapshot of slot→request at dispatch time; a row whose slot was
+        # freed or reassigned since is discarded on read. Admissions splice
+        # onto the *latest* device state, so a request admitted while two
+        # chunks are in flight starts decoding two chunks later — ordering
+        # stays linear because everything chains through donated buffers.
+        self._inflight = []  # [(toks_device, [req-or-None per slot])]
+        while self._running:
+            try:
+                self._admit_pending()
+                self._sweep_finishes()
+                dispatchable = any(
+                    s is not None and not s.exhausted for s in self._slots
+                )
+                if dispatchable and len(self._inflight) < 2:
+                    self._dispatch_chunk()
+                    continue
+                if self._inflight:
+                    self._consume_oldest_chunk()
+                    continue
+                # Idle: block until an admission arrives.
+                try:
+                    req = self._admissions.get(timeout=0.05)
+                except _queue.Empty:
+                    continue
+                self._admit_one(req)
+            except Exception:  # pragma: no cover - scheduler must survive
+                logger.exception("batch scheduler error; failing active slots")
+                self._inflight.clear()
+                for i, slot in enumerate(self._slots):
+                    if slot is not None:
+                        self._finish(i, "abort",
+                                     error=EngineUnavailable("scheduler error"))
+        # Shutdown: fail everything still holding a coroutine — active
+        # slots (their in-flight chunks are abandoned) and queued
+        # admissions — so no generate() call blocks forever.
+        self._inflight.clear()
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._finish(i, "abort",
+                             error=EngineUnavailable("engine stopped"))
+        while True:
+            try:
+                req = self._admissions.get_nowait()
+            except _queue.Empty:
+                break
+            self._emit(req, "error", EngineUnavailable("engine stopped"))
+
+    def _admit_pending(self) -> None:
+        while None in self._slots:
+            try:
+                req = self._admissions.get_nowait()
+            except _queue.Empty:
+                return
+            self._admit_one(req)
+
+    def _admit_one(self, req: _Request) -> None:
+        if req.cancel.is_set():
+            return
+        if req.deadline is not None and time.monotonic() > req.deadline:
+            self._emit(req, "error",
+                       GenerationTimeout("timed out waiting for a slot"))
+            return
+        slot_idx = self._slots.index(None)
+        cfg = self.model_cfg
+        t_adm = time.monotonic()
+
+        last_logits, scratch, n_prompt = self._prefill_prompt(
+            req.prompt_ids, req.max_tokens
+        )
+        self._key_d, sub = jax.random.split(self._key_d)
+        first_tok_d = self._sample_fn(
+            last_logits, sub, jnp.asarray(req.temperature, jnp.float32)
+        )
+        first_tok = int(first_tok_d[0])
+        t_prefill_done = time.monotonic()
+
+        slot = _Slot(
+            req=req,
+            detok=StreamDecoder(self.tokenizer),
+            n_prompt=n_prompt,
+            pos=n_prompt,
+            prefill_ms=(t_prefill_done - t_adm) * 1000.0,
+            queue_ms=(t_adm - req.t_submit) * 1000.0,
+            t_decode0=t_prefill_done,
+        )
+        self._slots[slot_idx] = slot
+
+        if first_tok in cfg.eos_ids:
+            self._finish(slot_idx, "stop")
+            return
+        piece = slot.detok.push(first_tok)
+        slot.t_first = time.monotonic()
+        if piece is not None:
+            self._emit(req, "token", piece)
+        if req.max_tokens <= 1:
+            self._finish(slot_idx, "length")
+            return
+
+        self._cache, self._tok_d, self._pos_d, self._temps_d = self._splice_fn(
+            self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
+            self._temps_d,
+            jnp.asarray(slot_idx, jnp.int32), jnp.asarray(n_prompt, jnp.int32),
+            jnp.asarray(first_tok, jnp.int32),
+            jnp.asarray(req.temperature, jnp.float32),
+        )
+
+    def _sweep_finishes(self) -> None:
+        """Host-only finishes before a dispatch: cancellation, deadline,
+        and KV capacity (``pos`` counts *scheduled* chunks, so in-flight
+        pipeline chunks can never write past the cache). A
+        capacity-exhausted slot is excluded from further dispatches but
+        only finished once its in-flight chunks are consumed — otherwise
+        up to 2×chunk_len already-generated tokens would be dropped."""
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if slot.req.cancel.is_set():
+                self._finish(i, "abort")
+            elif (slot.req.deadline is not None
+                  and time.monotonic() > slot.req.deadline):
+                self._finish(i, "timeout",
+                             error=GenerationTimeout("generation timeout"))
+            elif slot.pos + self.chunk_len > self.max_seq_len:
+                slot.exhausted = True
+                if slot.chunks_inflight == 0:
+                    self._finish(i, "length")
+
+    def _dispatch_chunk(self) -> None:
+        active_list = [s is not None and not s.exhausted for s in self._slots]
+        if not any(active_list):
+            return
+        active = jnp.asarray(active_list, jnp.bool_)
+        toks_d, self._tok_d, self._pos_d, self._cache, self._key_d = (
+            self._chunk_fn(self.params, self._tok_d, self._pos_d, self._cache,
+                           self._key_d, self._temps_d, active)
+        )
+        snapshot = [
+            s.req if s is not None and not s.exhausted else None
+            for s in self._slots
+        ]
+        for s in self._slots:
+            if s is not None and not s.exhausted:
+                s.pos += self.chunk_len
+                s.chunks_inflight += 1
+        self._inflight.append((toks_d, snapshot))
+
+    def _consume_oldest_chunk(self) -> None:
+        toks_d, snapshot = self._inflight.pop(0)
+        toks = np.asarray(toks_d)  # [N, chunk_len] — the per-chunk round trip
+        cfg = self.model_cfg
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.req is not snapshot[i]:
+                continue  # slot freed/reassigned since this chunk launched
+            slot.chunks_inflight -= 1
+            new_ids = []
+            finish = None
+            for tid in toks[i]:
+                tid = int(tid)
+                if tid in cfg.eos_ids:
+                    finish = "stop"
+                    break
+                new_ids.append(tid)
+                if len(slot.detok.ids) + len(new_ids) >= slot.req.max_tokens:
+                    finish = "length"
+                    break
+            if new_ids:
+                if slot.t_first is None:
+                    slot.t_first = time.monotonic()
+                piece = slot.detok.push(*new_ids)
+                if piece is not None:
+                    self._emit(slot.req, "token", piece)
+            if finish is not None:
+                self._finish(i, finish)
+
+    def _finish(self, slot_idx: int, finish: str,
+                error: Optional[BaseException] = None) -> None:
+        slot = self._slots[slot_idx]
+        self._slots[slot_idx] = None
+        if slot is None:  # pragma: no cover - defensive
+            return
+        if error is not None:
+            self._emit(slot.req, "error", error)
+            return
+        piece = slot.detok.flush()
+        if piece is not None:
+            self._emit(slot.req, "token", piece)
+        t_end = time.monotonic()
+        result = EngineResult(
+            text=slot.detok.text,
+            prompt_tokens=slot.n_prompt,
+            completion_tokens=len(slot.detok.ids),
+            queue_ms=slot.queue_ms,
+            prefill_ms=slot.prefill_ms,
+            decode_ms=(t_end - slot.t_decode0) * 1000.0,
+            ttft_ms=((slot.t_first or t_end) - slot.req.t_submit) * 1000.0,
+            finish_reason=finish,
+            engine=self.name,
+        )
+        self._emit(slot.req, "done", result)
+
+    def _emit(self, req: _Request, event: str, payload) -> None:
+        req.loop.call_soon_threadsafe(req.out_queue.put_nowait, (event, payload))
+
+    # ------------------------------------------------------------ serving
+
+    async def _stream_events(self, prompt: str, *, max_tokens: int,
+                             temperature: float, timeout: Optional[float]):
+        if not self._ready:
+            raise EngineUnavailable("engine not started")
+        t_submit = time.monotonic()
+        deadline = (t_submit + timeout) if timeout else None
+        max_tokens = max(1, min(max_tokens, self.max_seq_len - 1))
+        req = _Request(
+            prompt_ids=self.tokenizer.encode(prompt),
+            max_tokens=max_tokens,
+            temperature=temperature,
+            deadline=deadline,
+            loop=asyncio.get_running_loop(),
+            out_queue=asyncio.Queue(),
+            cancel=threading.Event(),
+            t_submit=t_submit,
+        )
+        self._admissions.put(req)
+        try:
+            while True:
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    # Worker enforces the deadline too; +2s grace covers a
+                    # chunk in flight before declaring it stuck.
+                    try:
+                        event, payload = await asyncio.wait_for(
+                            req.out_queue.get(), remaining + 2.0
+                        )
+                    except asyncio.TimeoutError:
+                        raise GenerationTimeout("generation exceeded timeout")
+                else:
+                    event, payload = await req.out_queue.get()
+                if event == "error":
+                    raise payload
+                yield (event, payload)
+                if event == "done":
+                    return
+        finally:
+            req.cancel.set()

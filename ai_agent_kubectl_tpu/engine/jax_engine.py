@@ -129,9 +129,21 @@ class JaxEngine:
         await asyncio.to_thread(self._start_blocking)
         self._lock = asyncio.Lock()
         self._ready = True
+        # One full generation through the real serving path: catches every
+        # lazily-compiled helper (key splits, sliced-logits sampling, ...)
+        # that the targeted warmups miss, so the first user request runs at
+        # steady-state TTFT. _ready must already be True here (generate()
+        # gates on it); start() just doesn't return until warmup is done,
+        # and the server awaits start() before accepting traffic.
+        try:
+            await self.generate("warmup: list pods", max_tokens=2,
+                                temperature=0.0)
+        except Exception:  # pragma: no cover - warmup must never kill startup
+            logger.exception("warmup generation failed")
 
-    def _start_blocking(self) -> None:
-        t0 = time.monotonic()
+    def _load(self) -> None:
+        """Tokenizer + weights (checkpoint or random init). Shared by the
+        single-sequence and batched engines."""
         if self.tokenizer is None:
             self.tokenizer = load_tokenizer(self.model_cfg, self.tokenizer_path)
         if self.params is None:
@@ -151,6 +163,7 @@ class JaxEngine:
                     jax.random.PRNGKey(self.seed), self.model_cfg, dtype=self.dtype
                 )
 
+    def _build_prefill_fns(self) -> None:
         cfg = self.model_cfg
 
         def prefill(params, tokens, positions, cache, *, kv_limit, impl):
@@ -172,6 +185,12 @@ class JaxEngine:
             self._prefill_fns[b] = jax.jit(
                 partial(prefill, kv_limit=b, impl=impl), donate_argnums=(3,)
             )
+
+    def _start_blocking(self) -> None:
+        t0 = time.monotonic()
+        self._load()
+        self._build_prefill_fns()
+        cfg = self.model_cfg
 
         # Warm-up compile on the smallest bucket so the first request
         # doesn't pay full compilation (SURVEY.md §3.3: init is where the
@@ -275,22 +294,15 @@ class JaxEngine:
         self._chunk_fns[chunk_len] = fn
         return fn
 
-    def _generate_blocking(self, prompt: str, max_tokens: int,
-                           temperature: float, deadline: Optional[float],
-                           cancel: Optional["threading.Event"] = None):
-        """Runs on a worker thread. Yields (event, payload) tuples:
-        ("token", text_piece) ... ("done", EngineResult)."""
-        cfg = self.model_cfg
-        t_start = time.monotonic()
-
-        # Clamp generation budget so the prompt always keeps >= 1 slot and
-        # decode positions can never run past the KV cache.
-        max_tokens = max(1, min(max_tokens, self.max_seq_len - 1))
-
-        prompt_ids = self.tokenizer.encode(prompt)
+    def _prefill_prompt(self, prompt_ids, max_tokens: int):
+        """Truncate → bucket-pad → jit prefill one prompt into a fresh
+        single-slot cache. Returns (last_logits [1, V], cache, n_prompt).
+        Shared by the single-sequence path and the batcher's admissions.
+        """
         # Leave room to generate, and fit the largest prefill bucket
         # (left-truncate: the query tail is the informative part).
-        max_prompt = min(self.max_seq_len - max_tokens, self.prefill_buckets[-1])
+        max_prompt = min(self.max_seq_len - max(1, max_tokens),
+                         self.prefill_buckets[-1])
         if len(prompt_ids) > max_prompt:
             prompt_ids = prompt_ids[-max_prompt:]
         n_prompt = len(prompt_ids)
@@ -303,8 +315,8 @@ class JaxEngine:
         # query can attend to them (mask is kv_pos <= q_pos).
         positions = np.broadcast_to(np.arange(bucket), (1, bucket)).astype(np.int32)
 
-        cache = KVCache.zeros(cfg, 1, self.max_seq_len, dtype=self.dtype)
-        t_prefill0 = time.monotonic()
+        cache = KVCache.zeros(self.model_cfg, 1, self.max_seq_len,
+                              dtype=self.dtype)
         logits, cache = self._prefill_fns[bucket](
             self.params, jnp.asarray(tokens), jnp.asarray(positions), cache
         )
@@ -314,7 +326,24 @@ class JaxEngine:
         cache = KVCache(k=cache.k, v=cache.v,
                         lengths=jnp.full((1,), n_prompt, jnp.int32))
         # Next-token logits sit at the last *valid* prompt position.
-        last_logits = logits[:, n_prompt - 1]
+        return logits[:, n_prompt - 1], cache, n_prompt
+
+    def _generate_blocking(self, prompt: str, max_tokens: int,
+                           temperature: float, deadline: Optional[float],
+                           cancel: Optional["threading.Event"] = None):
+        """Runs on a worker thread. Yields (event, payload) tuples:
+        ("token", text_piece) ... ("done", EngineResult)."""
+        cfg = self.model_cfg
+        t_start = time.monotonic()
+
+        # Clamp generation budget so the prompt always keeps >= 1 slot and
+        # decode positions can never run past the KV cache.
+        max_tokens = max(1, min(max_tokens, self.max_seq_len - 1))
+
+        t_prefill0 = time.monotonic()
+        last_logits, cache, n_prompt = self._prefill_prompt(
+            self.tokenizer.encode(prompt), max_tokens
+        )
 
         key = jax.random.PRNGKey(self.seed + n_prompt)
         key, chunk_key = jax.random.split(key)

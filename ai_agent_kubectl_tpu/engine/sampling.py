@@ -49,3 +49,24 @@ def sample_token_traced(
         return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
     return jax.lax.cond(temperature > 0.0, _sampled, _greedy, None)
+
+
+def sample_tokens_batched(
+    logits: jnp.ndarray,            # [batch, vocab] f32
+    key: jax.Array,
+    temperatures: jnp.ndarray,      # [batch] traced — per-slot temperature
+) -> jnp.ndarray:
+    """Per-row sampling for the continuous-batching decode step: each slot
+    carries its own temperature. The categorical branch (gumbel noise over
+    batch×vocab — expensive on the VPU) only executes when some slot
+    actually samples; all-greedy batches take the argmax-only path."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _with_sampling(_):
+        t = jnp.maximum(temperatures, 1e-6)[:, None]
+        sampled = jax.random.categorical(key, logits / t, axis=-1)
+        return jnp.where(temperatures > 0.0, sampled.astype(jnp.int32), greedy)
+
+    return jax.lax.cond(
+        jnp.any(temperatures > 0.0), _with_sampling, lambda _: greedy, None
+    )

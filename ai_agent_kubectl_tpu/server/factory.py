@@ -56,8 +56,16 @@ def build_engine(cfg: ServiceConfig) -> Engine:
                 base_url=cfg.openai_base_url,
                 timeout=cfg.llm_timeout,
             )
-        if cfg.engine == "jax":
+        if cfg.engine in ("jax", "jax-batched"):
             from .. import engine as _engine_pkg  # noqa: F401
+
+            # DECODE_BATCH_SIZE > 1 (the default) serves through the
+            # continuous-batching scheduler; =1 keeps the simpler
+            # single-sequence engine.
+            if cfg.engine == "jax-batched" or cfg.decode_batch_size > 1:
+                from ..engine.batcher import BatchedJaxEngine
+
+                return BatchedJaxEngine.from_config(cfg)
             from ..engine.jax_engine import JaxEngine
 
             return JaxEngine.from_config(cfg)
