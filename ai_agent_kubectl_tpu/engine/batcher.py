@@ -85,12 +85,13 @@ class BatchedJaxEngine(JaxEngine):
     name = "jax-batched"
 
     def __init__(self, *args, batch_size: int = 8, chunk_len: int = 8,
-                 **kwargs):
+                 kv_page_size: int = 16, **kwargs):
         super().__init__(*args, **kwargs)
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
         self.batch_size = batch_size
         self.chunk_len = chunk_len
+        self.kv_page_size = max(1, kv_page_size)
         self._admissions: _queue.Queue = _queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._running = False
@@ -108,6 +109,7 @@ class BatchedJaxEngine(JaxEngine):
             prefill_buckets=cfg.prefill_bucket_list,
             attn_impl=cfg.attn_impl,
             batch_size=cfg.decode_batch_size,
+            kv_page_size=cfg.kv_page_size,
         )
 
     # ------------------------------------------------------------ startup
@@ -118,6 +120,13 @@ class BatchedJaxEngine(JaxEngine):
         self._build_prefill_fns()
         cfg = self.model_cfg
         N, S = self.batch_size, self.max_seq_len
+        # The slot caches carry one chunk of slack past max_seq so the final
+        # chunk of a near-capacity slot can always run at full chunk_len —
+        # one compiled chunk program, no tail-length variants to compile
+        # mid-serving, and tail tokens are never cut off at chunk
+        # granularity. A slot is exhausted once pos >= max_seq (sweep), so
+        # writes stay < S + chunk_len by construction.
+        S_alloc = S + self.chunk_len
 
         def batched_chunk(params, tok, pos, cache, key, temps, active):
             """scan of chunk_len batched decode steps. Inactive slots keep
@@ -127,7 +136,7 @@ class BatchedJaxEngine(JaxEngine):
             def body(carry, _):
                 tok, pos, cache, key = carry
                 logits, cache = forward(params, cfg, tok, pos, cache,
-                                        kv_limit=S, attn_impl="dense")
+                                        kv_limit=S_alloc, attn_impl="dense")
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens_batched(logits[:, 0], sub, temps)
                 nxt = jnp.where(active, nxt, tok[:, 0])
@@ -155,7 +164,7 @@ class BatchedJaxEngine(JaxEngine):
         self._splice_fn = jax.jit(splice, donate_argnums=(0, 3, 4, 5))
 
         # Device-side scheduler state.
-        self._cache = KVCache.zeros(cfg, N, S, dtype=self.dtype)
+        self._cache = KVCache.zeros(cfg, N, S_alloc, dtype=self.dtype)
         self._tok_d = jnp.zeros((N, 1), jnp.int32)
         self._pos_d = jnp.zeros((N, 1), jnp.int32)
         self._temps_d = jnp.zeros((N,), jnp.float32)
@@ -204,6 +213,26 @@ class BatchedJaxEngine(JaxEngine):
         if self._worker is not None:
             await asyncio.to_thread(self._worker.join, 10.0)
             self._worker = None
+
+    def stats(self) -> dict:
+        """Live scheduler state for the /metrics gauges (scraped, not
+        pushed): slot occupancy, admission queue depth, and page-granular
+        KV-pool accounting (page size = KV_PAGE_SIZE)."""
+        slots = list(getattr(self, "_slots", None) or [])
+        page = self.kv_page_size
+        pages_per_slot = -(-self.max_seq_len // page)
+        # pos can run into the S_alloc slack on a final chunk; clamp so
+        # used never exceeds total (utilization ratios stay <= 1).
+        used = sum(
+            -(-min(s.pos, self.max_seq_len) // page)
+            for s in slots if s is not None
+        )
+        return {
+            "batch_occupancy": sum(s is not None for s in slots),
+            "queue_depth": self._admissions.qsize(),
+            "kv_pages_used": used,
+            "kv_pages_total": self.batch_size * pages_per_slot,
+        }
 
     # ---------------------------------------------------------- scheduler
 
@@ -333,16 +362,20 @@ class BatchedJaxEngine(JaxEngine):
                   and time.monotonic() > slot.req.deadline):
                 self._finish(i, "timeout",
                              error=GenerationTimeout("generation timeout"))
-            elif slot.pos + self.chunk_len > self.max_seq_len:
+            elif slot.pos >= self.max_seq_len:
                 slot.exhausted = True
                 if slot.chunks_inflight == 0:
                     self._finish(i, "length")
 
     def _dispatch_chunk(self) -> None:
-        active_list = [s is not None and not s.exhausted for s in self._slots]
-        if not any(active_list):
+        active_slots = [s for s in self._slots
+                        if s is not None and not s.exhausted]
+        if not active_slots:
             return
-        active = jnp.asarray(active_list, jnp.bool_)
+        active = jnp.asarray(
+            [s is not None and not s.exhausted for s in self._slots],
+            jnp.bool_,
+        )
         toks_d, self._tok_d, self._pos_d, self._cache, self._key_d = (
             self._chunk_fn(self.params, self._tok_d, self._pos_d, self._cache,
                            self._key_d, self._temps_d, active)
@@ -351,10 +384,9 @@ class BatchedJaxEngine(JaxEngine):
             s.req if s is not None and not s.exhausted else None
             for s in self._slots
         ]
-        for s in self._slots:
-            if s is not None and not s.exhausted:
-                s.pos += self.chunk_len
-                s.chunks_inflight += 1
+        for s in active_slots:
+            s.pos += self.chunk_len
+            s.chunks_inflight += 1
         self._inflight.append((toks_d, snapshot))
 
     def _consume_oldest_chunk(self) -> None:
@@ -412,7 +444,14 @@ class BatchedJaxEngine(JaxEngine):
         self._emit(slot.req, "done", result)
 
     def _emit(self, req: _Request, event: str, payload) -> None:
-        req.loop.call_soon_threadsafe(req.out_queue.put_nowait, (event, payload))
+        try:
+            req.loop.call_soon_threadsafe(req.out_queue.put_nowait,
+                                          (event, payload))
+        except RuntimeError:
+            # The request's event loop already closed (client's asyncio.run
+            # exited after a timeout). Drop the event — nothing is listening
+            # — and keep the scheduler alive for the other slots.
+            logger.warning("dropping %r event for a dead event loop", event)
 
     # ------------------------------------------------------------ serving
 

@@ -10,6 +10,7 @@ temperature=0 default matches app.py:109.
 
 from __future__ import annotations
 
+import json
 import time
 from typing import AsyncIterator, Optional
 
@@ -97,7 +98,53 @@ class OpenAICompatEngine:
         temperature: float = 0.0,
         timeout: Optional[float] = None,
     ) -> AsyncIterator[str]:
-        result = await self.generate(
-            prompt, max_tokens=max_tokens, temperature=temperature, timeout=timeout
-        )
-        yield result.text
+        """True token streaming: ``stream: true`` ChatCompletions request,
+        SSE ``data:`` chunks parsed incrementally (delta.content pieces)."""
+        if self._client is None or not self.api_key:
+            raise EngineUnavailable("OpenAI engine not initialized (missing key?)")
+        try:
+            async with self._client.stream(
+                "POST",
+                "/chat/completions",
+                json={
+                    "model": self.model,
+                    "messages": [{"role": "user", "content": prompt}],
+                    "temperature": temperature,
+                    "max_tokens": max_tokens,
+                    "stream": True,
+                },
+                timeout=timeout or self.timeout,
+            ) as resp:
+                if resp.status_code >= 400:
+                    body = (await resp.aread()).decode(errors="replace")
+                    raise EngineUnavailable(
+                        f"upstream returned {resp.status_code}: {body[:200]}"
+                    )
+                ctype = resp.headers.get("content-type", "")
+                if "text/event-stream" not in ctype:
+                    # Upstream ignored stream:true (minimal OpenAI-compat
+                    # stubs, the OPENAI_BASE_URL escape hatch): fall back to
+                    # the one-shot completion body.
+                    data = json.loads(await resp.aread())
+                    text = data["choices"][0]["message"]["content"]
+                    if text:
+                        yield text
+                    return
+                async for line in resp.aiter_lines():
+                    line = line.strip()
+                    if not line.startswith("data:"):
+                        continue  # comments / blank keep-alives
+                    data = line[len("data:"):].strip()
+                    if data == "[DONE]":
+                        break
+                    try:
+                        choices = json.loads(data).get("choices", [])
+                    except json.JSONDecodeError:
+                        continue  # tolerate malformed keep-alive frames
+                    if not choices:
+                        continue
+                    piece = (choices[0].get("delta") or {}).get("content")
+                    if piece:
+                        yield piece
+        except httpx.TimeoutException as e:
+            raise GenerationTimeout(str(e)) from e

@@ -83,33 +83,72 @@ class StreamDecoder:
     exactly the final text with no transient mojibake. Genuinely invalid
     bytes (still U+FFFD after 3 more chars arrive) are released by
     ``push``; ``flush`` emits any held-back tail at end of stream.
+
+    Decoding is incremental via a sliding prefix window (the scheme TGI and
+    vLLM use): only ids from ``_prefix_idx`` on are re-decoded per push, and
+    the newly-emitted piece is the *difference* between that window's decode
+    with and without the unemitted tail. Because both decodes share the same
+    window start, tokenizer behaviours that depend on sequence position
+    (SentencePiece ``Strip(left)``, byte-fallback fusing) cancel out of the
+    diff — chunk decodes are never naively concatenated. The window advances
+    whenever its text is fully emitted, so per-push cost is independent of
+    generation length.
     """
+
+    #: Force-release threshold: a window this long that still ends in
+    #: held-back U+FFFD is a garbage run, not a split character — emit it
+    #: so per-push cost stays bounded even on adversarial byte streams.
+    _WINDOW_CAP = 64
 
     def __init__(self, tokenizer: Tokenizer):
         self._tokenizer = tokenizer
         self.ids: List[int] = []
         self.text = ""
-        self._emitted = 0
+        self._prefix_idx = 0    # window start: left context for the decode
+        self._read_idx = 0      # ids before this are fully emitted
+        self._win_emitted = 0   # chars emitted beyond the prefix decode
+
+    def _window(self) -> tuple:
+        """(chars already emitted in window coordinates, window decode)."""
+        prefix_text = self._tokenizer.decode(
+            self.ids[self._prefix_idx:self._read_idx]
+        )
+        new_text = self._tokenizer.decode(self.ids[self._prefix_idx:])
+        return len(prefix_text) + self._win_emitted, new_text
+
+    def _advance(self) -> None:
+        self._prefix_idx = self._read_idx
+        self._read_idx = len(self.ids)
+        self._win_emitted = 0
 
     def push(self, *new_ids: int) -> Optional[str]:
         """Add token ids; return the newly-stable text piece (or None)."""
         self.ids.extend(new_ids)
-        self.text = self._tokenizer.decode(self.ids)
-        stable = len(self.text)
-        while (stable > self._emitted and self.text[stable - 1] == "�"
-               and len(self.text) - stable < 3):
+        base, new_text = self._window()
+        stable = len(new_text)
+        while (stable > base and new_text[stable - 1] == "�"
+               and len(new_text) - stable < 3):
             stable -= 1
-        if stable > self._emitted:
-            piece = self.text[self._emitted:stable]
-            self._emitted = stable
-            return piece
-        return None
+        if len(self.ids) - self._prefix_idx > self._WINDOW_CAP:
+            stable = len(new_text)
+        piece = None
+        emitted_to = base
+        if stable > base:
+            piece = new_text[base:stable]
+            self.text += piece
+            self._win_emitted += stable - base
+            emitted_to = stable
+        if emitted_to == len(new_text):
+            self._advance()
+        return piece
 
     def flush(self) -> Optional[str]:
         """Emit any held-back tail (end of stream)."""
-        if self._emitted < len(self.text):
-            piece = self.text[self._emitted:]
-            self._emitted = len(self.text)
+        base, new_text = self._window()
+        if len(new_text) > base:
+            piece = new_text[base:]
+            self.text += piece
+            self._advance()
             return piece
         return None
 

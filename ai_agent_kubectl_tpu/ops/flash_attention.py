@@ -75,7 +75,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, pos_ref, o_ref, *, block_k: int,
     qpos = pos_ref[0, :, :]                                    # [bq, 1] int32
 
     # Only KV tiles that intersect the causal window [0, max(qpos)] matter.
-    n_blocks = jnp.max(qpos) // block_k + 1
+    # Clamp to the number of KV tiles so query positions >= KVLEN (a caller
+    # contract violation) can never drive out-of-bounds tile reads.
+    n_blocks = jnp.minimum(jnp.max(qpos) // block_k + 1,
+                           k_ref.shape[2] // block_k)
 
     m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)

@@ -351,6 +351,15 @@ async def handle_health(request: web.Request) -> web.Response:
 
 async def handle_metrics(request: web.Request) -> web.Response:
     svc: Service = request.app["service"]
+    # Engine gauges are sampled at scrape time (live scheduler state, not a
+    # push path the hot loop has to touch).
+    stats_fn = getattr(svc.engine, "stats", None)
+    if callable(stats_fn):
+        stats = stats_fn()
+        svc.metrics.batch_occupancy.set(stats.get("batch_occupancy", 0))
+        svc.metrics.queue_depth.set(stats.get("queue_depth", 0))
+        svc.metrics.kv_pool_used.set(stats.get("kv_pages_used", 0))
+        svc.metrics.kv_pool_total.set(stats.get("kv_pages_total", 0))
     return web.Response(body=svc.metrics.render(), content_type="text/plain")
 
 

@@ -299,3 +299,22 @@ async def test_stream_generic_engine_error_yields_error_event():
         assert "event: error" in text and "internal error" in text
     finally:
         await client.close()
+
+
+async def test_metrics_engine_gauges_sampled_at_scrape():
+    # The batch/queue/KV gauges are set from engine.stats() at scrape time
+    # (round-1 review: registered but never written).
+    class StatsEngine(FakeEngine):
+        def stats(self):
+            return {"batch_occupancy": 3, "queue_depth": 2,
+                    "kv_pages_used": 12, "kv_pages_total": 256}
+
+    client, _ = await make_client(make_cfg(), engine=StatsEngine())
+    try:
+        text = await (await client.get("/metrics")).text()
+        assert "engine_batch_occupancy 3.0" in text
+        assert "engine_queue_depth 2.0" in text
+        assert "engine_kv_pages_used 12.0" in text
+        assert "engine_kv_pages_total 256.0" in text
+    finally:
+        await client.close()

@@ -168,3 +168,66 @@ async def test_stream_cancellation_releases_engine(engine):
         engine.generate("list pods", max_tokens=4), timeout=30
     )
     assert result.engine == "jax"
+
+
+def test_stream_decoder_window_stays_bounded():
+    # Incremental decode: per-push work is a short trailing window, not the
+    # whole id list (round-1 review: O(n^2) host cost per generation).
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder
+
+    tok = ByteTokenizer()
+    detok = StreamDecoder(tok)
+    for i in tok.encode("kubectl get pods -n staging " * 40, add_bos=False):
+        detok.push(i)
+        assert len(detok.ids) - detok._prefix_idx <= 4
+    assert detok.text == "kubectl get pods -n staging " * 40
+
+
+def test_stream_decoder_caps_invalid_run_window():
+    # An adversarial all-invalid byte stream must not grow the re-decode
+    # window without bound: past _WINDOW_CAP it is force-released.
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder
+
+    tok = ByteTokenizer()
+    detok = StreamDecoder(tok)
+    cap = StreamDecoder._WINDOW_CAP
+    for _ in range(cap * 3):
+        detok.push(0xFF + tok.SPECIALS)
+        assert len(detok.ids) - detok._prefix_idx <= cap + 1
+    detok.flush()
+    assert detok.text == "�" * (cap * 3)
+
+
+def test_stream_decoder_position_dependent_tokenizer():
+    # Real HF tokenizers (SentencePiece Strip(left=1) + byte-fallback Fuse)
+    # decode a chunk of ids differently standalone than in context — naive
+    # chunk-decode concatenation drops the inter-token spaces (code-review
+    # regression). The prefix-window diff must reproduce the full decode.
+    from ai_agent_kubectl_tpu.engine.tokenizer import StreamDecoder
+
+    class StripTokenizer:
+        """decode() joins word-pieces with spaces and strips the leading
+        space — the observable behaviour of Llama/Gemma tokenizer.json."""
+
+        vocab = ["<pad>", "<bos>", "<eos>", "kubectl", "get", "pods", "-n",
+                 "staging"]
+        eos_ids = (2,)
+        bos_id, pad_id, vocab_size = 1, 0, 8
+
+        def encode(self, text, *, add_bos=True):
+            return [self.vocab.index(w) for w in text.split()]
+
+        def decode(self, ids):
+            return " ".join(self.vocab[i] for i in ids if i > 2)
+
+    tok = StripTokenizer()
+    ids = tok.encode("kubectl get pods -n staging")
+    full = tok.decode(ids)
+
+    detok = StreamDecoder(tok)
+    pieces = [p for i in ids if (p := detok.push(i)) is not None]
+    tail = detok.flush()
+    if tail is not None:
+        pieces.append(tail)
+    assert "".join(pieces) == full == "kubectl get pods -n staging"
+    assert detok.text == full

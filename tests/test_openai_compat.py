@@ -1,0 +1,95 @@
+"""OpenAICompatEngine tests against a local stub ChatCompletions server
+(the reference's OPENAI_BASE_URL escape hatch, app.py:114-115) — including
+true SSE streaming (round-1 review: generate_stream awaited the full
+completion)."""
+
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from ai_agent_kubectl_tpu.engine.openai_compat import OpenAICompatEngine
+
+
+async def _stub_server(stream_pieces):
+    async def chat(request):
+        body = await request.json()
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            for piece in stream_pieces:
+                frame = {"choices": [{"delta": {"content": piece}}]}
+                await resp.write(f"data: {json.dumps(frame)}\n\n".encode())
+            # keep-alive comment + empty-choices frame must be tolerated
+            await resp.write(b": ping\n\n")
+            await resp.write(b'data: {"choices": []}\n\n')
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        return web.json_response({
+            "choices": [{"message": {"content": "".join(stream_pieces)}}],
+            "usage": {"prompt_tokens": 5, "completion_tokens": 3},
+        })
+
+    app = web.Application()
+    app.router.add_post("/chat/completions", chat)
+    server = TestServer(app)
+    await server.start_server()
+    return server
+
+
+async def test_generate_via_stub():
+    server = await _stub_server(["kubectl ", "get ", "pods"])
+    engine = OpenAICompatEngine(
+        api_key="test", base_url=str(server.make_url("/")), timeout=5.0
+    )
+    await engine.start()
+    try:
+        result = await engine.generate("list pods")
+        assert result.text == "kubectl get pods"
+        assert result.prompt_tokens == 5
+    finally:
+        await engine.stop()
+        await server.close()
+
+
+async def test_stream_yields_incremental_sse_pieces():
+    pieces = ["kubectl ", "get ", "pods ", "-n ", "staging"]
+    server = await _stub_server(pieces)
+    engine = OpenAICompatEngine(
+        api_key="test", base_url=str(server.make_url("/")), timeout=5.0
+    )
+    await engine.start()
+    try:
+        got = [p async for p in engine.generate_stream("list pods")]
+        # True streaming: one piece per SSE chunk, not one final blob.
+        assert got == pieces
+    finally:
+        await engine.stop()
+        await server.close()
+
+
+async def test_stream_falls_back_when_upstream_does_not_stream():
+    # A minimal OpenAI-compat stub may ignore stream:true and return a plain
+    # JSON completion; generate_stream must yield it rather than nothing.
+    async def chat(request):
+        return web.json_response({
+            "choices": [{"message": {"content": "kubectl get pods"}}],
+        })
+
+    app = web.Application()
+    app.router.add_post("/chat/completions", chat)
+    server = TestServer(app)
+    await server.start_server()
+    engine = OpenAICompatEngine(
+        api_key="test", base_url=str(server.make_url("/")), timeout=5.0
+    )
+    await engine.start()
+    try:
+        got = [p async for p in engine.generate_stream("list pods")]
+        assert got == ["kubectl get pods"]
+    finally:
+        await engine.stop()
+        await server.close()
