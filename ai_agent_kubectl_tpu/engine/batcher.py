@@ -71,11 +71,12 @@ class _Slot:
     detok: StreamDecoder
     n_prompt: int
     pos: int                      # scheduled device position (counts dispatched chunks)
-    prefill_ms: float
     queue_ms: float
-    t_decode0: float
+    t_admit: float
+    prefill_ms: float = 0.0       # admit → first-token consume (set on consume)
+    t_decode0: float = 0.0
     t_first: Optional[float] = None
-    chunks_inflight: int = 0      # dispatched-but-unconsumed chunks for this slot
+    chunks_inflight: int = 0      # dispatched-but-unconsumed entries for this slot
     exhausted: bool = False       # KV capacity reached; drain pipeline, then finish
 
 
@@ -128,15 +129,30 @@ class BatchedJaxEngine(JaxEngine):
         # writes stay < S + chunk_len by construction.
         S_alloc = S + self.chunk_len
 
-        def batched_chunk(params, tok, pos, cache, key, temps, active):
-            """scan of chunk_len batched decode steps. Inactive slots keep
-            their position (their writes land on a frozen, dead cache slot
-            and their tokens are discarded)."""
+        # Decode-attention cost grows with the KV span it reads. Rather
+        # than attending over the full S_alloc cache every token (round-1:
+        # cost ∝ max_seq even for 40-token sequences), the chunk program is
+        # compiled per KV *bucket* — a pow2 ladder topped by S_alloc — and
+        # dispatch picks the smallest bucket covering every live position.
+        # All buckets are warmed at startup, so bucket growth never
+        # compiles mid-serving.
+        ladder, b = [], 128
+        while b < S_alloc:
+            ladder.append(b)
+            b *= 2
+        self._kv_buckets = tuple(ladder) + (S_alloc,)
+
+        def batched_chunk(params, tok, pos, cache, key, temps, active, *,
+                          kv_limit):
+            """scan of chunk_len batched decode steps attending over
+            cache[:, :kv_limit]. Inactive slots keep their position (their
+            writes land on a frozen, dead cache slot and their tokens are
+            discarded)."""
 
             def body(carry, _):
                 tok, pos, cache, key = carry
                 logits, cache = forward(params, cfg, tok, pos, cache,
-                                        kv_limit=S_alloc, attn_impl="dense")
+                                        kv_limit=kv_limit, attn_impl="dense")
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens_batched(logits[:, 0], sub, temps)
                 nxt = jnp.where(active, nxt, tok[:, 0])
@@ -148,15 +164,22 @@ class BatchedJaxEngine(JaxEngine):
             )
             return jnp.swapaxes(toks, 0, 1), tok, pos, cache, key
 
-        self._chunk_fn = jax.jit(batched_chunk, donate_argnums=(1, 2, 3))
+        self._chunk_fns = {
+            b: jax.jit(partial(batched_chunk, kv_limit=b),
+                       donate_argnums=(1, 2, 3))
+            for b in self._kv_buckets
+        }
 
         def splice(cache, src_k, src_v, tok, pos, temps,
                    slot, n_prompt, first_tok, temperature):
-            """Insert a prefilled request into slot ``slot``."""
+            """Insert a prefilled request into slot ``slot``.
+            ``first_tok`` is a [1] device array — admission never reads it
+            back to the host; the token value travels to the client via the
+            inflight pipeline."""
             k = jax.lax.dynamic_update_slice(cache.k, src_k, (0, slot, 0, 0, 0))
             v = jax.lax.dynamic_update_slice(cache.v, src_v, (0, slot, 0, 0, 0))
             lengths = cache.lengths.at[slot].set(n_prompt)
-            tok = tok.at[slot, 0].set(first_tok)
+            tok = tok.at[slot, 0].set(first_tok[0])
             pos = pos.at[slot, 0].set(n_prompt)
             temps = temps.at[slot].set(temperature)
             return KVCache(k=k, v=v, lengths=lengths), tok, pos, temps
@@ -187,14 +210,15 @@ class BatchedJaxEngine(JaxEngine):
         self._cache, self._tok_d, self._pos_d, self._temps_d = self._splice_fn(
             self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
             self._temps_d, jnp.asarray(0, jnp.int32),
-            jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(1, jnp.int32), jnp.zeros((1,), jnp.int32),
             jnp.asarray(0.0, jnp.float32),
         )
-        toks, self._tok_d, self._pos_d, self._cache, self._key_d = (
-            self._chunk_fn(self.params, self._tok_d, self._pos_d, self._cache,
-                           self._key_d, self._temps_d,
-                           jnp.zeros((N,), jnp.bool_))
-        )
+        for kv_b in self._kv_buckets:
+            toks, self._tok_d, self._pos_d, self._cache, self._key_d = (
+                self._chunk_fns[kv_b](
+                    self.params, self._tok_d, self._pos_d, self._cache,
+                    self._key_d, self._temps_d, jnp.zeros((N,), jnp.bool_))
+            )
         toks.block_until_ready()
 
         self._running = True
@@ -239,13 +263,25 @@ class BatchedJaxEngine(JaxEngine):
     def _worker_loop(self) -> None:
         # Chunk pipeline, two deep: dispatch chunk N+1 (chained on device
         # arrays) before pulling chunk N's tokens, so the host↔device round
-        # trip overlaps decode compute. Each in-flight chunk carries a
-        # snapshot of slot→request at dispatch time; a row whose slot was
-        # freed or reassigned since is discarded on read. Admissions splice
-        # onto the *latest* device state, so a request admitted while two
-        # chunks are in flight starts decoding two chunks later — ordering
-        # stays linear because everything chains through donated buffers.
-        self._inflight = []  # [(toks_device, [req-or-None per slot])]
+        # trip overlaps decode compute. The inflight queue carries two entry
+        # kinds, consumed strictly FIFO:
+        #
+        # - ("chunk", toks_d, snapshot): a decode chunk for all slots, with
+        #   a snapshot of slot→request at dispatch time; a row whose slot
+        #   was freed or reassigned since is discarded on read.
+        # - ("first", tok_d, req, slot_idx): an admission's first token,
+        #   still on device — admissions never block on a host read (the
+        #   round-1 bottleneck: one blocking RTT per admission serialized
+        #   prefill against decode). The value is pulled when the entry
+        #   reaches the queue head, by which time later-dispatched work
+        #   overlaps the transfer.
+        #
+        # Admissions splice onto the *latest* device state, so a request
+        # admitted while two chunks are in flight starts decoding two
+        # chunks later — ordering stays linear because everything chains
+        # through donated buffers. Only "chunk" entries count against the
+        # pipeline depth; first-token entries are transfers, not compute.
+        self._inflight = []
         while self._running:
             try:
                 self._admit_pending()
@@ -253,11 +289,14 @@ class BatchedJaxEngine(JaxEngine):
                 dispatchable = any(
                     s is not None and not s.exhausted for s in self._slots
                 )
-                if dispatchable and len(self._inflight) < 2:
+                chunks_in_pipe = sum(
+                    1 for e in self._inflight if e[0] == "chunk"
+                )
+                if dispatchable and chunks_in_pipe < 2:
                     self._dispatch_chunk()
                     continue
                 if self._inflight:
-                    self._consume_oldest_chunk()
+                    self._consume_oldest()
                     continue
                 # Idle: block until an admission arrives.
                 try:
@@ -296,6 +335,11 @@ class BatchedJaxEngine(JaxEngine):
             self._admit_one(req)
 
     def _admit_one(self, req: _Request) -> None:
+        """Dispatch-only admission: prefill → device-side first-token
+        sample → KV splice, all chained on device arrays with zero host
+        reads. The first token reaches the client through the inflight
+        pipeline (``_consume_first``), overlapping its transfer with decode
+        chunks instead of stalling every active slot on a round trip."""
         if req.cancel.is_set():
             return
         if req.deadline is not None and time.monotonic() > req.deadline:
@@ -303,7 +347,6 @@ class BatchedJaxEngine(JaxEngine):
                        GenerationTimeout("timed out waiting for a slot"))
             return
         slot_idx = self._slots.index(None)
-        cfg = self.model_cfg
         t_adm = time.monotonic()
 
         last_logits, scratch, n_prompt = self._prefill_prompt(
@@ -313,38 +356,48 @@ class BatchedJaxEngine(JaxEngine):
         first_tok_d = self._sample_fn(
             last_logits, sub, jnp.asarray(req.temperature, jnp.float32)
         )
-        first_tok = int(first_tok_d[0])
-        t_prefill_done = time.monotonic()
+        self._cache, self._tok_d, self._pos_d, self._temps_d = self._splice_fn(
+            self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
+            self._temps_d,
+            jnp.asarray(slot_idx, jnp.int32), jnp.asarray(n_prompt, jnp.int32),
+            first_tok_d,
+            jnp.asarray(req.temperature, jnp.float32),
+        )
 
         slot = _Slot(
             req=req,
             detok=StreamDecoder(self.tokenizer),
             n_prompt=n_prompt,
             pos=n_prompt,
-            prefill_ms=(t_prefill_done - t_adm) * 1000.0,
             queue_ms=(t_adm - req.t_submit) * 1000.0,
-            t_decode0=t_prefill_done,
+            t_admit=t_adm,
+            t_decode0=t_adm,
+            chunks_inflight=1,
         )
         self._slots[slot_idx] = slot
+        self._inflight.append(("first", first_tok_d, req, slot_idx))
 
-        if first_tok in cfg.eos_ids:
+    def _consume_first(self, tok_d, req: _Request, slot_idx: int) -> None:
+        """Pull an admission's first token off the device and deliver it.
+        EOS / single-token finishes happen here; the slot's already-
+        dispatched decode chunks are then discarded via snapshot mismatch."""
+        slot = self._slots[slot_idx]
+        if slot is None or slot.req is not req:
+            return  # finished/raced before its first token arrived
+        slot.chunks_inflight -= 1
+        first_tok = int(np.asarray(tok_d)[0])
+        now = time.monotonic()
+        slot.t_first = now
+        slot.t_decode0 = now
+        slot.prefill_ms = (now - slot.t_admit) * 1000.0
+        if first_tok in self.model_cfg.eos_ids:
             self._finish(slot_idx, "stop")
             return
         piece = slot.detok.push(first_tok)
-        slot.t_first = time.monotonic()
         if piece is not None:
             self._emit(req, "token", piece)
         if req.max_tokens <= 1:
             self._finish(slot_idx, "length")
-            return
-
-        self._cache, self._tok_d, self._pos_d, self._temps_d = self._splice_fn(
-            self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
-            self._temps_d,
-            jnp.asarray(slot_idx, jnp.int32), jnp.asarray(n_prompt, jnp.int32),
-            jnp.asarray(first_tok, jnp.int32),
-            jnp.asarray(req.temperature, jnp.float32),
-        )
 
     def _sweep_finishes(self) -> None:
         """Host-only finishes before a dispatch: cancellation, deadline,
@@ -376,9 +429,17 @@ class BatchedJaxEngine(JaxEngine):
             [s is not None and not s.exhausted for s in self._slots],
             jnp.bool_,
         )
+        # Smallest KV bucket covering every live position this chunk can
+        # reach: decode attention cost tracks actual sequence lengths, not
+        # max_seq. Buckets only grow, so recently-admitted short sequences
+        # sharing a batch with a long one pay the long one's bucket — the
+        # static-shape trade, same as the active-slot masking.
+        needed = max(s.pos for s in active_slots) + self.chunk_len
+        bucket = next(b for b in self._kv_buckets if b >= needed)
         toks_d, self._tok_d, self._pos_d, self._cache, self._key_d = (
-            self._chunk_fn(self.params, self._tok_d, self._pos_d, self._cache,
-                           self._key_d, self._temps_d, active)
+            self._chunk_fns[bucket](
+                self.params, self._tok_d, self._pos_d, self._cache,
+                self._key_d, self._temps_d, active)
         )
         snapshot = [
             s.req if s is not None and not s.exhausted else None
@@ -387,10 +448,15 @@ class BatchedJaxEngine(JaxEngine):
         for s in active_slots:
             s.pos += self.chunk_len
             s.chunks_inflight += 1
-        self._inflight.append((toks_d, snapshot))
+        self._inflight.append(("chunk", toks_d, snapshot))
 
-    def _consume_oldest_chunk(self) -> None:
-        toks_d, snapshot = self._inflight.pop(0)
+    def _consume_oldest(self) -> None:
+        entry = self._inflight.pop(0)
+        if entry[0] == "first":
+            _, tok_d, req, slot_idx = entry
+            self._consume_first(tok_d, req, slot_idx)
+            return
+        _, toks_d, snapshot = entry
         toks = np.asarray(toks_d)  # [N, chunk_len] — the per-chunk round trip
         cfg = self.model_cfg
         for i, slot in enumerate(self._slots):

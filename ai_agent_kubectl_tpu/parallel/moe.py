@@ -4,16 +4,26 @@
 FLOPs but correct for any batch and trivially shardable; it is the
 numerical reference for the EP path and what small/test configs use.
 
+``expert_parallel_moe`` is the scaled version (SURVEY.md §2.4 EP row;
+BASELINE config 4, Mixtral-8x7B over ICI): experts are sharded over the
+``expert`` mesh axis, tokens are sharded over the same axis, and each
+token's top-k expert computations happen on the device owning the expert —
+GShard-style capacity-bounded dispatch/combine with two
+``jax.lax.all_to_all`` collectives riding ICI. FLOPs per token are O(k),
+not O(E).
+
 Routing follows Mixtral (top-k over router logits, softmax *after*
 selection, renormalized over the selected experts).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from functools import partial
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.config import ModelConfig
 
@@ -42,11 +52,99 @@ def dense_moe(cfg: ModelConfig, lp: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarr
 
     gate = jnp.einsum("bsd,edf->bsef", x, lp["w_gate"])
     up = jnp.einsum("bsd,edf->bsef", x, lp["w_up"])
-    if cfg.activation == "gelu":
-        act = jax.nn.gelu(gate, approximate=True)
-    else:
-        act = jax.nn.silu(gate)
-    hidden = act * up                                             # [B, S, E, F]
+    hidden = _act(cfg, gate) * up                                 # [B, S, E, F]
     y = jnp.einsum("bsef,efd->bsed", hidden, lp["w_down"])        # [B, S, E, D]
     return jnp.einsum("bsed,bse->bsd", y.astype(jnp.float32),
                       mix).astype(x.dtype)
+
+
+def _act(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """One activation dispatch shared by dense and EP paths, so a config
+    change can never make them silently diverge."""
+    if cfg.activation == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _ffn(cfg: ModelConfig, w_gate, w_up, w_down, x):
+    """Batched per-expert FFN: x [E_local, C, D] -> [E_local, C, D]."""
+    gate = jnp.einsum("ecd,edf->ecf", x, w_gate)
+    up = jnp.einsum("ecd,edf->ecf", x, w_up)
+    return jnp.einsum("ecf,efd->ecd", _act(cfg, gate) * up, w_down)
+
+
+def _ep_shard(x, router, w_gate, w_up, w_down, *, cfg: ModelConfig,
+              axis: str, capacity: int):
+    """Per-device body: dispatch local tokens to expert owners, run local
+    experts, combine back. x: [T_local, D]; router: [D, E] (replicated);
+    w_*: [E_local, ...] (expert-sharded)."""
+    T, D = x.shape
+    logits = (x @ router).astype(jnp.float32)                 # [T, E]
+    mix, _ = router_weights(cfg, logits)                      # [T, E] dense
+    routed = (mix > 0.0).astype(jnp.float32)                  # 0/1 mask
+
+    # Position of each token within its expert's capacity buffer; tokens
+    # past capacity are dropped (GShard semantics — capacity_factor bounds
+    # the static buffer; no host sync, no ragged shapes).
+    pos = jnp.cumsum(routed, axis=0) - 1.0                    # [T, E]
+    keep = routed * (pos < capacity)
+    disp = keep[..., None] * jax.nn.one_hot(pos, capacity)    # [T, E, C]
+    comb = disp * mix[..., None]                              # [T, E, C]
+
+    x_send = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)  # [E, C, D]
+    # all-to-all #1: each device keeps its local experts' buffers from
+    # every source device -> [E_local, ep*C, D].
+    x_recv = jax.lax.all_to_all(x_send, axis, split_axis=0,
+                                concat_axis=1, tiled=True)
+    y_recv = _ffn(cfg, w_gate, w_up, w_down, x_recv)
+    # all-to-all #2: route results back to the source device -> [E, C, D].
+    y_send = jax.lax.all_to_all(y_recv, axis, split_axis=1,
+                                concat_axis=0, tiled=True)
+    return jnp.einsum("ecd,tec->td", y_send.astype(jnp.float32),
+                      comb).astype(x.dtype)
+
+
+def expert_parallel_moe(
+    cfg: ModelConfig,
+    lp: Dict[str, Any],
+    x: jnp.ndarray,               # [B, S, D]
+    mesh: Mesh,
+    *,
+    axis: str = "expert",
+    capacity_factor: float = 2.0,
+    capacity: Optional[int] = None,
+) -> jnp.ndarray:
+    """Top-k MoE with experts and tokens sharded over ``axis``.
+
+    Numerics match :func:`dense_moe` for every token that fits within the
+    per-expert ``capacity`` (tokens beyond it are dropped — standard
+    capacity-factor semantics; pass an explicit ``capacity`` to make drops
+    impossible, e.g. in parity tests).
+
+    Requires B*S divisible by the axis size and n_experts divisible by the
+    axis size.
+    """
+    B, S, D = x.shape
+    T = B * S
+    ep = mesh.shape[axis]
+    E = cfg.n_experts
+    if T % ep or E % ep:
+        raise ValueError(
+            f"tokens {T} and experts {E} must divide the {axis} axis ({ep})"
+        )
+    T_local = T // ep
+    if capacity is None:
+        capacity = max(1, int(
+            capacity_factor * cfg.experts_per_token * T_local / E
+        ))
+
+    fn = jax.shard_map(
+        partial(_ep_shard, cfg=cfg, axis=axis, capacity=capacity),
+        mesh=mesh,
+        in_specs=(P(axis, None), P(), P(axis, None, None),
+                  P(axis, None, None), P(axis, None, None)),
+        out_specs=P(axis, None),
+    )
+    flat = fn(x.reshape(T, D), lp["router"], lp["w_gate"], lp["w_up"],
+              lp["w_down"])
+    return flat.reshape(B, S, D)

@@ -1,0 +1,80 @@
+"""Expert-parallel MoE dispatch parity vs dense_moe on the 8-virtual-device
+CPU mesh, with the all-to-all collectives asserted in HLO (SURVEY.md §2.4 EP
+row; VERDICT round-1 item 6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ai_agent_kubectl_tpu.models.config import get_config
+from ai_agent_kubectl_tpu.models.transformer import init_params
+from ai_agent_kubectl_tpu.parallel.mesh import MeshConfig, build_mesh
+from ai_agent_kubectl_tpu.parallel.moe import dense_moe, expert_parallel_moe
+
+
+def _layer0(cfg, key=0):
+    params = init_params(jax.random.PRNGKey(key), get_config("toy-moe"),
+                         dtype=jnp.float32)
+    lp = {k: v[0] for k, v in params["layers"].items()
+          if k in ("router", "w_gate", "w_up", "w_down")}
+    return lp
+
+
+def _x(cfg, B, S, key=1):
+    return jax.random.normal(jax.random.PRNGKey(key), (B, S, cfg.dim),
+                             jnp.float32)
+
+
+@pytest.mark.parametrize("ep", [2, 4])
+def test_ep_matches_dense(ep):
+    cfg = get_config("toy-moe")
+    lp = _layer0(cfg)
+    x = _x(cfg, 2, 8)
+    mesh = build_mesh(MeshConfig(expert=ep), devices=jax.devices()[:ep])
+    # capacity = all local tokens -> drops impossible -> exact parity
+    out = expert_parallel_moe(cfg, lp, x, mesh, capacity=16)
+    ref = dense_moe(cfg, lp, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ep_flops_are_topk_not_all_experts():
+    # The dispatched FFN runs on [E_local, ep*C, D] buffers: total expert
+    # FLOPs scale with k*T*capacity_factor, not E*T. Assert via the HLO
+    # that the per-device einsum operand is capacity-bounded and that the
+    # two all-to-alls are present.
+    cfg = get_config("toy-moe")
+    lp = _layer0(cfg)
+    x = _x(cfg, 2, 8)
+    mesh = build_mesh(MeshConfig(expert=4), devices=jax.devices()[:4])
+    lowered = jax.jit(
+        lambda x: expert_parallel_moe(cfg, lp, x, mesh, capacity=4)
+    ).lower(x)
+    hlo = lowered.compile().as_text()
+    assert hlo.count("all-to-all") >= 2
+    # dense evaluation of all experts on all tokens would need a
+    # [T, E, F] intermediate; the dispatched path's FFN input is
+    # [E_local, ep*C, D] = [E/4, 16, D]
+    E_local = cfg.n_experts // 4
+    assert f"f32[{E_local},16,{cfg.mlp_hidden}]" in hlo
+
+
+def test_ep_capacity_drops_are_bounded():
+    # With capacity 1 per expert most tokens drop; the op must still run
+    # and produce finite outputs (dropped tokens contribute zero).
+    cfg = get_config("toy-moe")
+    lp = _layer0(cfg)
+    x = _x(cfg, 2, 8)
+    mesh = build_mesh(MeshConfig(expert=2), devices=jax.devices()[:2])
+    out = expert_parallel_moe(cfg, lp, x, mesh, capacity=1)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_ep_rejects_indivisible():
+    cfg = get_config("toy-moe")
+    lp = _layer0(cfg)
+    mesh = build_mesh(MeshConfig(expert=8), devices=jax.devices()[:8])
+    x = _x(cfg, 1, 3)  # 3 tokens over 8-way axis
+    with pytest.raises(ValueError, match="divide"):
+        expert_parallel_moe(cfg, lp, x, mesh)
