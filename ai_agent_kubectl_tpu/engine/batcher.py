@@ -78,6 +78,7 @@ class _Slot:
     t_first: Optional[float] = None
     chunks_inflight: int = 0      # dispatched-but-unconsumed entries for this slot
     exhausted: bool = False       # KV capacity reached; drain pipeline, then finish
+    prefix_hit: bool = False      # served from the system-prompt prefix-KV cache
 
 
 class BatchedJaxEngine(JaxEngine):
@@ -119,6 +120,7 @@ class BatchedJaxEngine(JaxEngine):
         t0 = time.monotonic()
         self._load()
         self._build_prefill_fns()
+        self._init_prefix_cache()
         cfg = self.model_cfg
         N, S = self.batch_size, self.max_seq_len
         # The slot caches carry one chunk of slack past max_seq so the final
@@ -349,7 +351,7 @@ class BatchedJaxEngine(JaxEngine):
         slot_idx = self._slots.index(None)
         t_adm = time.monotonic()
 
-        last_logits, scratch, n_prompt = self._prefill_prompt(
+        last_logits, scratch, n_prompt, prefix_hit = self._prefill_prompt(
             req.prompt_ids, req.max_tokens
         )
         self._key_d, sub = jax.random.split(self._key_d)
@@ -373,6 +375,7 @@ class BatchedJaxEngine(JaxEngine):
             t_admit=t_adm,
             t_decode0=t_adm,
             chunks_inflight=1,
+            prefix_hit=prefix_hit,
         )
         self._slots[slot_idx] = slot
         self._inflight.append(("first", first_tok_d, req, slot_idx))
@@ -504,6 +507,7 @@ class BatchedJaxEngine(JaxEngine):
             prefill_ms=slot.prefill_ms,
             decode_ms=(t_end - slot.t_decode0) * 1000.0,
             ttft_ms=((slot.t_first or t_end) - slot.req.t_submit) * 1000.0,
+            prefix_cache_hit=slot.prefix_hit,
             finish_reason=finish,
             engine=self.name,
         )

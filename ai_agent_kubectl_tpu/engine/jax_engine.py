@@ -71,6 +71,7 @@ class JaxEngine:
         max_seq_len: int = 1024,
         prefill_buckets: tuple = (64, 128, 256, 512, 1024),
         attn_impl: str = "auto",
+        prefix_cache: bool = True,
         seed: int = 0,
     ):
         self.model_cfg = model_cfg
@@ -90,6 +91,7 @@ class JaxEngine:
             # TPU. Off-TPU the kernel would run interpreted — use XLA dense.
             attn_impl = "flash" if jax.default_backend() == "tpu" else "dense"
         self.attn_impl = attn_impl
+        self.use_prefix_cache = prefix_cache
         self.seed = seed
 
         self.tokenizer = tokenizer
@@ -97,8 +99,11 @@ class JaxEngine:
         self._ready = False
         self._lock: Optional[asyncio.Lock] = None
         self._prefill_fns = {}
+        self._suffix_prefill_fns = {}  # (bucket, kv_limit) -> jitted prefill
         self._chunk_fns = {}   # chunk_len -> jitted decode chunk
         self._sample_fn = jax.jit(sample_token_traced)
+        self._prefix = None            # PrefixKV once built
+        self._splice_prefix_fn = None
 
     #: decode chunk sizes (tokens per device dispatch), largest first. The
     #: scheduler greedily decomposes the remaining budget over these, so a
@@ -117,6 +122,7 @@ class JaxEngine:
             max_seq_len=cfg.max_seq_len,
             prefill_buckets=cfg.prefill_bucket_list,
             attn_impl=cfg.attn_impl,
+            prefix_cache=cfg.hbm_prefix_cache,
         )
 
     # ------------------------------------------------------------ startup
@@ -163,6 +169,23 @@ class JaxEngine:
                     jax.random.PRNGKey(self.seed), self.model_cfg, dtype=self.dtype
                 )
 
+    def _prefill_impl_for(self, q_len: int, kv_len: int) -> str:
+        """attn impl for a prefill shape, with per-shape dense fallback
+        when the flash kernel can't tile it (e.g. PREFILL_BUCKETS=192 or
+        head_dim 64)."""
+        from ..ops.flash_attention import flash_supported
+
+        impl = self.attn_impl
+        if impl == "flash" and not flash_supported(
+            q_len, kv_len, self.model_cfg.head_dim
+        ):
+            logger.warning(
+                "Prefill %dq/%dkv: shapes not flash-tileable, using dense",
+                q_len, kv_len,
+            )
+            impl = "dense"
+        return impl
+
     def _build_prefill_fns(self) -> None:
         cfg = self.model_cfg
 
@@ -170,26 +193,90 @@ class JaxEngine:
             return forward(params, cfg, tokens, positions, cache,
                            kv_limit=kv_limit, attn_impl=impl)
 
-        from ..ops.flash_attention import flash_supported
-
+        self._prefill_raw = prefill
         for b in self.prefill_buckets:
-            # Per-bucket fallback: a bucket the flash kernel can't tile
-            # (e.g. PREFILL_BUCKETS=192 or head_dim 64) serves dense while
-            # eligible buckets keep the flash path.
-            impl = self.attn_impl
-            if impl == "flash" and not flash_supported(b, b, cfg.head_dim):
-                logger.warning(
-                    "Bucket %d: shapes not flash-tileable, using dense", b
-                )
-                impl = "dense"
+            impl = self._prefill_impl_for(b, b)
             self._prefill_fns[b] = jax.jit(
                 partial(prefill, kv_limit=b, impl=impl), donate_argnums=(3,)
             )
+
+    def _get_suffix_prefill_fn(self, bucket: int, kv_limit: int):
+        """Prefill program for a prefix-cache suffix: queries are one
+        ``bucket`` of suffix tokens at offset positions, attending over
+        ``[0, kv_limit)`` (prefix + suffix span, tile-rounded)."""
+        key = (bucket, kv_limit)
+        fn = self._suffix_prefill_fns.get(key)
+        if fn is None:
+            impl = self._prefill_impl_for(bucket, kv_limit)
+            fn = jax.jit(
+                partial(self._prefill_raw, kv_limit=kv_limit, impl=impl),
+                donate_argnums=(3,),
+            )
+            self._suffix_prefill_fns[key] = fn
+        return fn
+
+    def _init_prefix_cache(self) -> None:
+        """Prefill the shared system prompt once and keep its KV in HBM
+        (engine/prefix_cache.py; the TTLCache analog of app.py:124-125).
+        Called from _start_blocking after the prefill programs exist."""
+        if not self.use_prefix_cache:
+            return
+        from .prefix_cache import PrefixKV, round_kv_limit
+        from .prompts import SYSTEM_PROMPT
+
+        cfg = self.model_cfg
+        ids = self.tokenizer.encode(SYSTEM_PROMPT)
+        P = len(ids)
+        bucket = next((b for b in self.prefill_buckets if b >= P), None)
+        if bucket is None or P >= self.max_seq_len:
+            logger.warning(
+                "Prefix cache disabled: system prompt is %d tokens, largest "
+                "prefill bucket %d, max_seq %d",
+                P, self.prefill_buckets[-1], self.max_seq_len,
+            )
+            return
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :P] = ids
+        positions = np.broadcast_to(np.arange(bucket), (1, bucket)).astype(np.int32)
+        cache = KVCache.zeros(cfg, 1, self.max_seq_len, dtype=self.dtype)
+        _, cache = self._prefill_fns[bucket](
+            self.params, jnp.asarray(tokens), jnp.asarray(positions), cache
+        )
+        # Trim to the true prefix length: the padding slots' garbage K/V is
+        # never copied into request caches.
+        self._prefix = PrefixKV(ids=list(ids), k=cache.k[:, :, :P],
+                                v=cache.v[:, :, :P])
+
+        def splice_prefix(cache, pk, pv):
+            k = jax.lax.dynamic_update_slice(cache.k, pk, (0, 0, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(cache.v, pv, (0, 0, 0, 0, 0))
+            lengths = jnp.full_like(cache.lengths, pk.shape[2])
+            return KVCache(k=k, v=v, lengths=lengths)
+
+        self._splice_prefix_fn = jax.jit(splice_prefix, donate_argnums=(0,))
+
+        # Warm the smallest suffix program — it is the TTFT path for every
+        # cache-hitting request.
+        sbucket = self.prefill_buckets[0]
+        kv_limit = round_kv_limit(P + sbucket, self.max_seq_len)
+        if kv_limit is not None:
+            scratch = KVCache.zeros(cfg, 1, self.max_seq_len, dtype=self.dtype)
+            scratch = self._splice_prefix_fn(scratch, self._prefix.k,
+                                             self._prefix.v)
+            spos = np.broadcast_to(P + np.arange(sbucket),
+                                   (1, sbucket)).astype(np.int32)
+            logits, _ = self._get_suffix_prefill_fn(sbucket, kv_limit)(
+                self.params, jnp.zeros((1, sbucket), jnp.int32),
+                jnp.asarray(spos), scratch,
+            )
+            logits.block_until_ready()
+        logger.info("Prefix-KV cache ready: %d tokens resident in HBM", P)
 
     def _start_blocking(self) -> None:
         t0 = time.monotonic()
         self._load()
         self._build_prefill_fns()
+        self._init_prefix_cache()
         cfg = self.model_cfg
 
         # Warm-up compile on the smallest bucket so the first request
@@ -296,13 +383,19 @@ class JaxEngine:
 
     def _prefill_prompt(self, prompt_ids, max_tokens: int):
         """Truncate → bucket-pad → jit prefill one prompt into a fresh
-        single-slot cache. Returns (last_logits [1, V], cache, n_prompt).
-        Shared by the single-sequence path and the batcher's admissions.
-        """
+        single-slot cache. Returns (last_logits [1, V], cache, n_prompt,
+        prefix_hit). Shared by the single-sequence path and the batcher's
+        admissions; prompts extending the cached system-prompt prefix skip
+        straight to suffix prefill (_prefill_suffix)."""
         # Leave room to generate, and fit the largest prefill bucket
         # (left-truncate: the query tail is the informative part).
         max_prompt = min(self.max_seq_len - max(1, max_tokens),
                          self.prefill_buckets[-1])
+        if (self._prefix is not None and len(prompt_ids) <= max_prompt
+                and self._prefix.matches(prompt_ids)):
+            out = self._prefill_suffix(prompt_ids)
+            if out is not None:
+                return out
         if len(prompt_ids) > max_prompt:
             prompt_ids = prompt_ids[-max_prompt:]
         n_prompt = len(prompt_ids)
@@ -326,7 +419,41 @@ class JaxEngine:
         cache = KVCache(k=cache.k, v=cache.v,
                         lengths=jnp.full((1,), n_prompt, jnp.int32))
         # Next-token logits sit at the last *valid* prompt position.
-        return logits[:, n_prompt - 1], cache, n_prompt
+        return logits[:, n_prompt - 1], cache, n_prompt, False
+
+    def _prefill_suffix(self, prompt_ids):
+        """Prefix-cache hit path: splice the resident system-prompt KV,
+        prefill only the suffix at offset positions. Returns the same tuple
+        as _prefill_prompt, or None when no suffix program fits (caller
+        falls back to full prefill)."""
+        from .prefix_cache import round_kv_limit
+
+        prefix = self._prefix
+        suffix = prompt_ids[prefix.n:]
+        n_suffix = len(suffix)
+        sbucket = next((b for b in self.prefill_buckets if b >= n_suffix),
+                       None)
+        if sbucket is None:
+            return None
+        kv_limit = round_kv_limit(prefix.n + sbucket, self.max_seq_len)
+        if kv_limit is None:
+            return None
+        n_prompt = prefix.n + n_suffix
+
+        cache = KVCache.zeros(self.model_cfg, 1, self.max_seq_len,
+                              dtype=self.dtype)
+        cache = self._splice_prefix_fn(cache, prefix.k, prefix.v)
+        tokens = np.zeros((1, sbucket), np.int32)
+        tokens[0, :n_suffix] = suffix
+        positions = np.broadcast_to(
+            prefix.n + np.arange(sbucket), (1, sbucket)
+        ).astype(np.int32)
+        logits, cache = self._get_suffix_prefill_fn(sbucket, kv_limit)(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions), cache
+        )
+        cache = KVCache(k=cache.k, v=cache.v,
+                        lengths=jnp.full((1,), n_prompt, jnp.int32))
+        return logits[:, n_suffix - 1], cache, n_prompt, True
 
     def _generate_blocking(self, prompt: str, max_tokens: int,
                            temperature: float, deadline: Optional[float],
@@ -341,7 +468,7 @@ class JaxEngine:
         max_tokens = max(1, min(max_tokens, self.max_seq_len - 1))
 
         t_prefill0 = time.monotonic()
-        last_logits, cache, n_prompt = self._prefill_prompt(
+        last_logits, cache, n_prompt, prefix_hit = self._prefill_prompt(
             self.tokenizer.encode(prompt), max_tokens
         )
 
@@ -452,6 +579,7 @@ class JaxEngine:
             prefill_ms=prefill_ms,
             decode_ms=decode_ms,
             ttft_ms=((t_first or t_end) - t_start) * 1000.0,
+            prefix_cache_hit=prefix_hit,
             finish_reason=finish,
             engine=self.name,
         )

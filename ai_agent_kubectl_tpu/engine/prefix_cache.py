@@ -1,0 +1,64 @@
+"""HBM-resident prefix-KV cache for the shared system prompt.
+
+The reference's TTLCache (app.py:124-125) memoizes query→command strings;
+its TPU-native analog memoizes the *KV states* of the shared system prompt
+(engine/prompts.py::SYSTEM_PROMPT — every request's prompt begins with it).
+The prefix is prefilled once at engine startup; each admission then:
+
+1. splices the cached prefix K/V into the request's fresh cache slots
+   ``[0:P)`` (one jitted dynamic_update_slice, no model FLOPs), and
+2. prefills only the per-request *suffix* at absolute positions ``P..`` —
+   correct by construction because RoPE and the causal mask take absolute
+   positions (models/transformer.py, ops/rope.py).
+
+Prefill compute therefore drops by the prefix share of the prompt (the
+system prompt dominates short kubectl queries), which is most of TTFT.
+
+Hit condition: the tokenized prompt strictly starts with the cached prefix
+ids. Tokenizers can merge across the boundary (BPE), so the check compares
+*token ids*, not strings — a boundary merge simply misses and takes the
+full-prefill path, never a wrong result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class PrefixKV:
+    """Precomputed KV state of a token prefix.
+
+    k, v: [n_layers, 1, P, n_kv_heads, head_dim] — trimmed to the true
+    prefix length P (no padding garbage; splicing copies exactly P slots).
+    """
+
+    ids: List[int]
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def n(self) -> int:
+        return len(self.ids)
+
+    def matches(self, prompt_ids: Sequence[int]) -> bool:
+        """True when ``prompt_ids`` strictly extends the cached prefix."""
+        n = self.n
+        return len(prompt_ids) > n and list(prompt_ids[:n]) == self.ids
+
+
+def round_kv_limit(needed: int, max_seq: int, tile: int = 128) -> Optional[int]:
+    """Smallest multiple of ``tile`` >= needed, capped at max_seq.
+
+    Suffix prefill attends over ``[0, P + bucket)``; rounding the static
+    kv_limit up to a tile multiple keeps the span flash-tileable (the extra
+    slots hold zeros that the causal mask and the kernel's block clamp never
+    read). None if the needed span exceeds the cache.
+    """
+    if needed > max_seq:
+        return None
+    rounded = -(-needed // tile) * tile
+    return min(rounded, max_seq)
