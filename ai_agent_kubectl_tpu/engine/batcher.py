@@ -73,7 +73,15 @@ class _Slot:
     pos: int                      # scheduled device position (counts dispatched chunks)
     queue_ms: float
     t_admit: float
-    prefill_ms: float = 0.0       # admit → first-token consume (set on consume)
+    prefill_ms: float = 0.0       # ADMISSION latency: admit → first-token
+                                  # consume. Unlike the single-sequence
+                                  # engine's prefill_ms (device prefill span,
+                                  # jax_engine.py), this includes up to two
+                                  # in-flight decode chunks of pipeline wait —
+                                  # the price of stall-free admissions. The
+                                  # isolated device span is unobservable
+                                  # without a host sync that would stall
+                                  # every slot.
     t_decode0: float = 0.0
     t_first: Optional[float] = None
     chunks_inflight: int = 0      # dispatched-but-unconsumed entries for this slot
@@ -110,6 +118,7 @@ class BatchedJaxEngine(JaxEngine):
             max_seq_len=cfg.max_seq_len,
             prefill_buckets=cfg.prefill_bucket_list,
             attn_impl=cfg.attn_impl,
+            prefix_cache=cfg.hbm_prefix_cache,
             batch_size=cfg.decode_batch_size,
             kv_page_size=cfg.kv_page_size,
         )

@@ -76,7 +76,16 @@ class OpenAICompatEngine:
             )
         except httpx.TimeoutException as e:
             raise GenerationTimeout(str(e)) from e
-        resp.raise_for_status()
+        except httpx.HTTPError as e:
+            # Connect/read/protocol failures map to the same degraded-mode
+            # exception as initialization failures (reference 503 path).
+            raise EngineUnavailable(f"upstream request failed: {e}") from e
+        if resp.status_code >= 400:
+            # Same mapping as the streaming path: upstream HTTP errors are
+            # engine unavailability, not an internal 500.
+            raise EngineUnavailable(
+                f"upstream returned {resp.status_code}: {resp.text[:200]}"
+            )
         data = resp.json()
         text = data["choices"][0]["message"]["content"]
         usage = data.get("usage", {})
@@ -148,3 +157,9 @@ class OpenAICompatEngine:
                         yield piece
         except httpx.TimeoutException as e:
             raise GenerationTimeout(str(e)) from e
+        except httpx.HTTPError as e:
+            # ConnectError before the stream opens, ReadError/protocol
+            # errors mid-stream: surface as EngineUnavailable so callers
+            # keying fallback on engine exception types catch them, matching
+            # the initialization and >=400 paths above.
+            raise EngineUnavailable(f"upstream stream failed: {e}") from e

@@ -28,6 +28,9 @@ class EngineResult:
     prompt_tokens: int = 0
     completion_tokens: int = 0
     queue_ms: float = 0.0
+    # Engine-dependent: the single-sequence engine reports the device
+    # prefill span; the continuous-batching engine reports admission
+    # latency (admit → first token), which includes pipeline wait.
     prefill_ms: float = 0.0
     decode_ms: float = 0.0
     ttft_ms: float = 0.0

@@ -129,8 +129,9 @@ class StreamDecoder:
         while (stable > base and new_text[stable - 1] == "�"
                and len(new_text) - stable < 3):
             stable -= 1
-        if len(self.ids) - self._prefix_idx > self._WINDOW_CAP:
-            stable = len(new_text)
+        if (stable < len(new_text)
+                and len(self.ids) - self._prefix_idx > self._WINDOW_CAP):
+            return self._force_release(base)
         piece = None
         emitted_to = base
         if stable > base:
@@ -140,6 +141,32 @@ class StreamDecoder:
             emitted_to = stable
         if emitted_to == len(new_text):
             self._advance()
+        return piece
+
+    def _force_release(self, base: int) -> Optional[str]:
+        """Window overflow with a held-back tail: release the window, but
+        advance only to the last id boundary whose decode is
+        replacement-free — a split UTF-8 sequence still pending completion
+        keeps its ids in the next window (advancing through it would make
+        the next window's prefix decode disagree with the full decode and
+        duplicate/drop characters). If no boundary in the unemitted tail is
+        clean, the run is genuine garbage: release everything."""
+        end = len(self.ids)
+        j = None
+        for cand in range(end, self._read_idx, -1):
+            t = self._tokenizer.decode(self.ids[self._prefix_idx:cand])
+            if not t.endswith("�"):
+                j = cand
+                break
+        if j is None:
+            j = end
+            t = self._tokenizer.decode(self.ids[self._prefix_idx:])
+        piece = t[base:] or None
+        if piece:
+            self.text += piece
+        self._prefix_idx = j
+        self._read_idx = j
+        self._win_emitted = 0
         return piece
 
     def flush(self) -> Optional[str]:

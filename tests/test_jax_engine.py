@@ -198,6 +198,26 @@ def test_stream_decoder_caps_invalid_run_window():
     assert detok.text == "�" * (cap * 3)
 
 
+def test_stream_decoder_cap_release_keeps_pending_split_char():
+    # Cap-triggered force release must not flush a split multi-byte char
+    # pending completion (round-2 advisor): the window advances only to the
+    # last replacement-free id boundary, so bytes completing after the
+    # release still decode correctly.
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder
+
+    tok = ByteTokenizer()
+    detok = StreamDecoder(tok)
+    cap = StreamDecoder._WINDOW_CAP
+    bad = 0xFF + tok.SPECIALS
+    # One oversized push: garbage run + clean 'x' + first byte of 'é'.
+    detok.push(*([bad] * cap + tok.encode("x", add_bos=False) + [0xC3 + tok.SPECIALS]))
+    # The partial 0xC3 must still be pending, not flushed as U+FFFD.
+    assert detok.text == "�" * cap + "x"
+    detok.push(0xA9 + tok.SPECIALS, *tok.encode("y", add_bos=False))
+    detok.flush()
+    assert detok.text == "�" * cap + "xéy"
+
+
 def test_stream_decoder_position_dependent_tokenizer():
     # Real HF tokenizers (SentencePiece Strip(left=1) + byte-fallback Fuse)
     # decode a chunk of ids differently standalone than in context — naive
