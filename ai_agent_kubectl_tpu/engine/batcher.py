@@ -119,6 +119,7 @@ class BatchedJaxEngine(JaxEngine):
             prefill_buckets=cfg.prefill_bucket_list,
             attn_impl=cfg.attn_impl,
             prefix_cache=cfg.hbm_prefix_cache,
+            mesh_shape=cfg.mesh_shape,
             batch_size=cfg.decode_batch_size,
             kv_page_size=cfg.kv_page_size,
         )
@@ -127,6 +128,7 @@ class BatchedJaxEngine(JaxEngine):
 
     def _start_blocking(self) -> None:
         t0 = time.monotonic()
+        self._setup_mesh()
         self._load()
         self._build_prefill_fns()
         self._init_prefix_cache()
@@ -163,7 +165,9 @@ class BatchedJaxEngine(JaxEngine):
             def body(carry, _):
                 tok, pos, cache, key = carry
                 logits, cache = forward(params, cfg, tok, pos, cache,
-                                        kv_limit=kv_limit, attn_impl="dense")
+                                        kv_limit=kv_limit, attn_impl="dense",
+                                        mesh=self.mesh,
+                                        token_mask=active[:, None])
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens_batched(logits[:, 0], sub, temps)
                 nxt = jnp.where(active, nxt, tok[:, 0])
@@ -197,22 +201,32 @@ class BatchedJaxEngine(JaxEngine):
 
         self._splice_fn = jax.jit(splice, donate_argnums=(0, 3, 4, 5))
 
-        # Device-side scheduler state.
-        self._cache = KVCache.zeros(cfg, N, S_alloc, dtype=self.dtype)
+        # Device-side scheduler state. Under a serving mesh, slots shard
+        # over ``data`` and KV heads over ``model`` (parallel/sharding.py);
+        # the jitted chunk/splice programs inherit these shardings, so XLA
+        # places the TP/EP collectives and the donated buffers never move.
+        self._cache = self._new_cache(N, S_alloc)
         self._tok_d = jnp.zeros((N, 1), jnp.int32)
         self._pos_d = jnp.zeros((N, 1), jnp.int32)
         self._temps_d = jnp.zeros((N,), jnp.float32)
+        if self.mesh is not None:
+            from ..parallel.sharding import shard_tokens
+
+            self._tok_d = shard_tokens(self._tok_d, self.mesh)
+            self._pos_d = shard_tokens(self._pos_d, self.mesh)
+            self._temps_d = shard_tokens(self._temps_d, self.mesh)
         self._key_d = jax.random.PRNGKey(self.seed)
         self._slots: List[Optional[_Slot]] = [None] * N
 
         # Warm-up: smallest prefill bucket + the decode chunk + splice.
         b = self.prefill_buckets[0]
-        scratch = KVCache.zeros(cfg, 1, S, dtype=self.dtype)
+        scratch = self._new_cache(1, S)
         logits, scratch = self._prefill_fns[b](
             self.params,
             jnp.zeros((1, b), jnp.int32),
             jnp.broadcast_to(jnp.arange(b), (1, b)).astype(jnp.int32),
             scratch,
+            jnp.ones((1, b), jnp.float32),
         )
         self._sample_fn(
             jnp.zeros((1, cfg.vocab_size), jnp.float32), self._key_d,

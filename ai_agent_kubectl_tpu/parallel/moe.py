@@ -73,14 +73,20 @@ def _ffn(cfg: ModelConfig, w_gate, w_up, w_down, x):
     return jnp.einsum("ecf,efd->ecd", _act(cfg, gate) * up, w_down)
 
 
-def _ep_shard(x, router, w_gate, w_up, w_down, *, cfg: ModelConfig,
-              axis: str, capacity: int):
+def _ep_shard(x, mask, router, w_gate, w_up, w_down, *, cfg: ModelConfig,
+              axis: str, model_axis: Optional[str], capacity: int):
     """Per-device body: dispatch local tokens to expert owners, run local
-    experts, combine back. x: [T_local, D]; router: [D, E] (replicated);
-    w_*: [E_local, ...] (expert-sharded)."""
+    experts, combine back. x: [T_local, D]; mask: [T_local] (0 = dead slot /
+    bucket padding — excluded from routing so garbage tokens never consume
+    expert capacity and starve live ones); router: [D, E] (replicated);
+    w_*: [E_local, ...] (expert-sharded; F additionally sharded over
+    ``model_axis`` when set — the per-device FFN then produces a partial sum
+    psum'd at the end, Megatron row-parallel style, instead of jit
+    all-gathering TP-sharded expert weights every step)."""
     T, D = x.shape
     logits = (x @ router).astype(jnp.float32)                 # [T, E]
     mix, _ = router_weights(cfg, logits)                      # [T, E] dense
+    mix = mix * mask.astype(jnp.float32)[:, None]
     routed = (mix > 0.0).astype(jnp.float32)                  # 0/1 mask
 
     # Position of each token within its expert's capacity buffer; tokens
@@ -100,8 +106,12 @@ def _ep_shard(x, router, w_gate, w_up, w_down, *, cfg: ModelConfig,
     # all-to-all #2: route results back to the source device -> [E, C, D].
     y_send = jax.lax.all_to_all(y_recv, axis, split_axis=1,
                                 concat_axis=0, tiled=True)
-    return jnp.einsum("ecd,tec->td", y_send.astype(jnp.float32),
-                      comb).astype(x.dtype)
+    y = jnp.einsum("ecd,tec->td", y_send.astype(jnp.float32), comb)
+    if model_axis is not None:
+        # FFN hidden dim was model-sharded: combine the partial sums on the
+        # smallest tensor in the pipeline ([T_local, D]).
+        y = jax.lax.psum(y, model_axis)
+    return y.astype(x.dtype)
 
 
 def expert_parallel_moe(
@@ -111,15 +121,24 @@ def expert_parallel_moe(
     mesh: Mesh,
     *,
     axis: str = "expert",
+    model_axis: str = "model",
     capacity_factor: float = 2.0,
     capacity: Optional[int] = None,
+    token_mask: Optional[jnp.ndarray] = None,   # [B, S]; 0 = padding/dead
 ) -> jnp.ndarray:
     """Top-k MoE with experts and tokens sharded over ``axis``.
 
-    Numerics match :func:`dense_moe` for every token that fits within the
-    per-expert ``capacity`` (tokens beyond it are dropped — standard
+    Numerics match :func:`dense_moe` for every live token that fits within
+    the per-expert ``capacity`` (tokens beyond it are dropped — standard
     capacity-factor semantics; pass an explicit ``capacity`` to make drops
-    impossible, e.g. in parity tests).
+    impossible, e.g. in parity tests). ``token_mask`` marks live tokens:
+    dead decode slots and bucket padding are excluded from routing so they
+    can never consume capacity that live tokens need.
+
+    When ``model_axis`` has size > 1 and the FFN hidden dim divides it, the
+    per-expert FFN additionally runs model-sharded (column/row parallel with
+    a final psum) so TP-sharded expert weights are used in place rather
+    than all-gathered into every step.
 
     Requires B*S divisible by the axis size and n_experts divisible by the
     axis size.
@@ -137,14 +156,20 @@ def expert_parallel_moe(
         capacity = max(1, int(
             capacity_factor * cfg.experts_per_token * T_local / E
         ))
+    tp = mesh.shape.get(model_axis, 1) if model_axis else 1
+    use_tp = tp > 1 and cfg.mlp_hidden % tp == 0
+    col = P(axis, None, model_axis) if use_tp else P(axis, None, None)
+    row = P(axis, model_axis, None) if use_tp else P(axis, None, None)
+    if token_mask is None:
+        token_mask = jnp.ones((B, S), jnp.float32)
 
     fn = jax.shard_map(
-        partial(_ep_shard, cfg=cfg, axis=axis, capacity=capacity),
+        partial(_ep_shard, cfg=cfg, axis=axis,
+                model_axis=model_axis if use_tp else None, capacity=capacity),
         mesh=mesh,
-        in_specs=(P(axis, None), P(), P(axis, None, None),
-                  P(axis, None, None), P(axis, None, None)),
+        in_specs=(P(axis, None), P(axis), P(), col, col, row),
         out_specs=P(axis, None),
     )
-    flat = fn(x.reshape(T, D), lp["router"], lp["w_gate"], lp["w_up"],
-              lp["w_down"])
+    flat = fn(x.reshape(T, D), token_mask.reshape(T), lp["router"],
+              lp["w_gate"], lp["w_up"], lp["w_down"])
     return flat.reshape(B, S, D)

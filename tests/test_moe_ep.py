@@ -71,6 +71,43 @@ def test_ep_capacity_drops_are_bounded():
     assert np.isfinite(np.asarray(out)).all()
 
 
+def test_ep_token_mask_keeps_dead_tokens_out_of_capacity():
+    # Dead decode slots / bucket padding must not consume expert capacity
+    # (round-3 review): with capacity sized for the live tokens only, the
+    # masked EP output matches dense exactly on every live row no matter
+    # what the garbage rows route to.
+    cfg = get_config("toy-moe")
+    lp = _layer0(cfg)
+    x = _x(cfg, 1, 8)
+    mask = jnp.asarray([[0, 0, 1, 1, 0, 0, 1, 1]], jnp.float32)
+    mesh = build_mesh(MeshConfig(expert=2), devices=jax.devices()[:2])
+    # capacity=2: the 2 live tokens per shard always fit (k=2 routings
+    # each over E_local*ep=4 experts), but 2 garbage tokens per shard
+    # would overflow it if they were allowed to route.
+    out = expert_parallel_moe(cfg, lp, x, mesh, capacity=2, token_mask=mask)
+    ref = dense_moe(cfg, lp, x)
+    live = np.asarray(mask[0]) > 0
+    np.testing.assert_allclose(np.asarray(out)[0, live],
+                               np.asarray(ref)[0, live],
+                               rtol=2e-5, atol=2e-5)
+    # Masked rows contribute exactly zero MLP output.
+    np.testing.assert_allclose(np.asarray(out)[0, ~live], 0.0, atol=1e-6)
+
+
+def test_ep_tp_sharded_ffn_matches_dense():
+    # EP under a TP mesh: expert FFN weights stay model-sharded in place
+    # (column/row parallel + psum) instead of being all-gathered per step.
+    cfg = get_config("toy-moe")
+    lp = _layer0(cfg)
+    x = _x(cfg, 2, 8)
+    mesh = build_mesh(MeshConfig(expert=2, model=2),
+                      devices=jax.devices()[:4])
+    out = expert_parallel_moe(cfg, lp, x, mesh, capacity=16)
+    ref = dense_moe(cfg, lp, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_ep_rejects_indivisible():
     cfg = get_config("toy-moe")
     lp = _layer0(cfg)
