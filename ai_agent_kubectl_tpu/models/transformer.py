@@ -143,7 +143,12 @@ def _moe_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray,
         ep = mesh.shape["expert"]
         B, S, _ = x.shape
         if ep > 1 and (B * S) % ep == 0 and cfg.n_experts % ep == 0:
-            return expert_parallel_moe(cfg, lp, x, mesh,
+            # Decode steps (S == 1) have only a handful of live tokens per
+            # shard; capacity_factor sizing there would make drops likely
+            # under routing skew. capacity = T_local makes drops impossible
+            # at negligible buffer cost, preserving single-device parity.
+            capacity = (B * S) // ep if S == 1 else None
+            return expert_parallel_moe(cfg, lp, x, mesh, capacity=capacity,
                                        token_mask=token_mask)
     return dense_moe(cfg, lp, x)
 
@@ -176,7 +181,15 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, h: jnp.ndarray, lp: Params,
     kv_pos = jnp.arange(kv_limit)[None, None, :]
     mask = kv_pos <= positions[:, :, None]
 
-    if attn_impl == "flash" and S > 1:
+    if attn_impl == "ring" and S > 1:
+        # Sequence-parallel self-attention over the chunk itself (no prior
+        # cache context) — the from-scratch long-prefill path. K/V blocks
+        # rotate over the ``seq`` mesh axis via ppermute; the cache write
+        # above still lands every position for later decode.
+        from ..parallel.ring_attention import ring_attention
+
+        attn = ring_attention(q, k, v, positions, mesh)
+    elif attn_impl == "flash" and S > 1:
         from ..ops.flash_attention import flash_attention_cached
 
         attn = flash_attention_cached(q, k_ctx, v_ctx, positions)

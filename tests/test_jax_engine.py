@@ -59,10 +59,12 @@ async def test_bucket_selection(engine):
         engine._bucket_for(1000)
 
 
-async def test_long_prompt_truncated_not_crashing(engine):
-    # Prompts longer than the biggest bucket are left-truncated.
+async def test_long_prompt_served_chunked_up_to_capacity(engine):
+    # Prompts beyond the biggest bucket are served via chunked prefill
+    # (round-3: no bucket truncation); only the KV capacity itself
+    # (max_seq - generation budget) left-truncates.
     result = await engine.generate("x" * 500, max_tokens=4)
-    assert result.prompt_tokens <= 128
+    assert result.prompt_tokens == engine.max_seq_len - 4
 
 
 async def test_engine_not_started_raises():

@@ -1,0 +1,95 @@
+"""Long-prompt prefill (VERDICT r2 item 5): prompts beyond the largest
+prefill bucket are served — chunked sequential prefill everywhere, ring-
+attention sequence-parallel prefill under a ``seq`` mesh axis — with full-
+context greedy parity against a big-bucket single-pass reference and no
+truncation."""
+
+import asyncio
+
+import pytest
+
+from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+from ai_agent_kubectl_tpu.engine.jax_engine import JaxEngine
+from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
+from ai_agent_kubectl_tpu.models.config import get_config
+
+# ~200 byte-tokens: beyond the (64,) bucket, within one 256 bucket.
+LONG_PROMPT = (
+    "Given the following cluster context, list every pod in the staging "
+    "namespace that has restarted more than three times in the last day, "
+    "including its node assignment and readiness state, sorted by restart "
+    "count descending; output wide."
+)
+
+
+def _mk(cls, buckets, mesh_shape="", **kw):
+    return cls(
+        get_config("toy-8m"),
+        tokenizer=ByteTokenizer(),
+        dtype="float32",
+        max_seq_len=384,
+        prefill_buckets=buckets,
+        attn_impl="dense",
+        prefix_cache=False,
+        mesh_shape=mesh_shape,
+        **kw,
+    )
+
+
+async def _gen(engine, prompt=LONG_PROMPT, max_tokens=8):
+    await engine.start()
+    try:
+        return await engine.generate(prompt, max_tokens=max_tokens,
+                                     temperature=0.0)
+    finally:
+        await engine.stop()
+
+
+async def test_chunked_prefill_matches_big_bucket_reference():
+    ref = await _gen(_mk(JaxEngine, (64, 128, 256)))
+    n_ids = len(ByteTokenizer().encode(LONG_PROMPT))
+    assert ref.prompt_tokens == n_ids  # fits one 256 bucket, no truncation
+
+    out = await _gen(_mk(JaxEngine, (64,)))
+    assert out.prompt_tokens == n_ids, "prompt must not be truncated"
+    assert out.text == ref.text
+
+
+async def test_ring_prefill_matches_big_bucket_reference():
+    ref = await _gen(_mk(JaxEngine, (64, 128, 256)))
+
+    eng = _mk(JaxEngine, (64,), mesh_shape="sp=8")
+    await eng.start()
+    try:
+        out = await eng.generate(LONG_PROMPT, max_tokens=8, temperature=0.0)
+        # The ring program (not the chunked fallback) served this prompt.
+        assert eng._ring_prefill_fns, "expected a compiled ring prefill"
+        assert 256 in eng._ring_prefill_fns
+    finally:
+        await eng.stop()
+    assert out.prompt_tokens == ref.prompt_tokens
+    assert out.text == ref.text
+
+
+async def test_batched_engine_serves_long_prompts():
+    ref = await _gen(_mk(JaxEngine, (64, 128, 256)))
+    eng = _mk(BatchedJaxEngine, (64,), batch_size=2, chunk_len=4)
+    await eng.start()
+    try:
+        out, short = await asyncio.gather(
+            eng.generate(LONG_PROMPT, max_tokens=8, temperature=0.0),
+            eng.generate("list pods", max_tokens=4, temperature=0.0),
+        )
+    finally:
+        await eng.stop()
+    assert out.prompt_tokens == ref.prompt_tokens
+    assert out.text == ref.text
+    assert short.completion_tokens >= 1
+
+
+async def test_overlong_prompt_still_left_truncates_at_capacity():
+    # Beyond KV capacity itself (max_seq - budget) the tail is kept.
+    eng = _mk(JaxEngine, (64,))
+    prompt = LONG_PROMPT * 4  # ~800 ids > max_seq 384
+    r = await _gen(eng, prompt=prompt, max_tokens=8)
+    assert r.prompt_tokens == 384 - 8

@@ -88,12 +88,15 @@ async def test_prefix_resident_and_suffix_bucket_small():
         await engine.stop()
 
 
-async def test_prefix_disabled_when_prompt_exceeds_buckets():
+async def test_prefix_disabled_when_no_room_for_suffix():
     engine = JaxEngine(
         get_config("toy-8m"), tokenizer=ByteTokenizer(), dtype="float32",
         max_seq_len=128, prefill_buckets=(64, 128), prefix_cache=True,
     )
-    # ByteTokenizer makes SYSTEM_PROMPT ~300 ids > largest bucket 128
+    # ByteTokenizer makes SYSTEM_PROMPT ~300 ids; 300 + smallest suffix
+    # bucket can never fit max_seq 128, so the cache is genuinely useless
+    # (prompts exceeding one bucket are now served chunked, so only the
+    # capacity condition disables it).
     await engine.start()
     try:
         assert engine._prefix is None
@@ -101,3 +104,41 @@ async def test_prefix_disabled_when_prompt_exceeds_buckets():
         assert r.prefix_cache_hit is False
     finally:
         await engine.stop()
+
+
+async def test_prefix_built_chunked_when_prompt_exceeds_buckets():
+    # The driver-bench configuration (round-2 weak #3): byte-level system
+    # prompt (~280 ids) > largest bucket 128 but well within max_seq 512.
+    # The prefix is now built by chunked sequential prefill, and a hit
+    # matches both the chunked full prefill and a single-big-bucket
+    # reference exactly.
+    def mk(prefix_cache, buckets):
+        return JaxEngine(
+            get_config("toy-8m"), tokenizer=ByteTokenizer(), dtype="float32",
+            max_seq_len=512, prefill_buckets=buckets,
+            prefix_cache=prefix_cache,
+        )
+
+    prompt = render_prompt("list all pods")
+    on = mk(True, (64, 128))
+    await on.start()
+    try:
+        assert on._prefix is not None, "prefix must build via chunked prefill"
+        hit = await on.generate(prompt, max_tokens=8, temperature=0.0)
+    finally:
+        await on.stop()
+
+    off = mk(False, (64, 128))
+    await off.start()
+    miss = await off.generate(prompt, max_tokens=8, temperature=0.0)
+    await off.stop()
+
+    ref_eng = mk(False, (512,))
+    await ref_eng.start()
+    ref = await ref_eng.generate(prompt, max_tokens=8, temperature=0.0)
+    await ref_eng.stop()
+
+    assert hit.prefix_cache_hit is True
+    assert miss.prefix_cache_hit is False
+    assert hit.prompt_tokens == miss.prompt_tokens == ref.prompt_tokens
+    assert hit.text == miss.text == ref.text
