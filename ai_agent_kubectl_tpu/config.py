@@ -137,6 +137,10 @@ class ServiceConfig:
     attn_impl: str = "auto"                 # ATTN_IMPL: auto | dense | flash (prefill kernel)
     kv_page_size: int = 16                  # KV_PAGE_SIZE (paged attention)
     hbm_prefix_cache: bool = True           # HBM_PREFIX_CACHE (system-prompt prefix KV)
+    # Persistent XLA compilation cache: warm restarts skip the multi-second
+    # per-program compiles (engine startup drops from ~80s to seconds).
+    # Empty string disables.
+    compile_cache_dir: str = "~/.cache/ai-agent-kubectl-tpu/xla-cache"  # COMPILE_CACHE_DIR
 
     # --- parallelism knobs ---
     mesh_shape: str = ""                    # MESH_SHAPE e.g. "data:1,model:8"
@@ -196,6 +200,9 @@ class ServiceConfig:
             attn_impl=(_env_str("ATTN_IMPL", "auto") or "auto").lower(),
             kv_page_size=_env_int("KV_PAGE_SIZE", 16),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
+            compile_cache_dir=os.getenv(
+                "COMPILE_CACHE_DIR", "~/.cache/ai-agent-kubectl-tpu/xla-cache"
+            ),
             mesh_shape=_env_str("MESH_SHAPE", "") or "",
             dcn_mesh_shape=_env_str("DCN_MESH_SHAPE", "") or "",
             distributed_init=_env_bool("DISTRIBUTED_INIT", False),

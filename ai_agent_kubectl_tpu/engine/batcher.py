@@ -120,6 +120,7 @@ class BatchedJaxEngine(JaxEngine):
             attn_impl=cfg.attn_impl,
             prefix_cache=cfg.hbm_prefix_cache,
             mesh_shape=cfg.mesh_shape,
+            compile_cache_dir=cfg.compile_cache_dir,
             batch_size=cfg.decode_batch_size,
             kv_page_size=cfg.kv_page_size,
         )
@@ -128,6 +129,7 @@ class BatchedJaxEngine(JaxEngine):
 
     def _start_blocking(self) -> None:
         t0 = time.monotonic()
+        self._setup_compile_cache()
         self._setup_mesh()
         self._load()
         self._build_prefill_fns()
@@ -149,11 +151,9 @@ class BatchedJaxEngine(JaxEngine):
         # dispatch picks the smallest bucket covering every live position.
         # All buckets are warmed at startup, so bucket growth never
         # compiles mid-serving.
-        ladder, b = [], 128
-        while b < S_alloc:
-            ladder.append(b)
-            b *= 2
-        self._kv_buckets = tuple(ladder) + (S_alloc,)
+        from .jax_engine import kv_bucket_ladder
+
+        self._kv_buckets = kv_bucket_ladder(S_alloc)
 
         def batched_chunk(params, tok, pos, cache, key, temps, active, *,
                           kv_limit):
@@ -179,7 +179,9 @@ class BatchedJaxEngine(JaxEngine):
             )
             return jnp.swapaxes(toks, 0, 1), tok, pos, cache, key
 
-        self._chunk_fns = {
+        # Keyed by KV bucket alone (one fixed chunk_len here) — distinct
+        # from the parent's (chunk_len, kv_limit)-keyed self._chunk_fns.
+        self._batch_chunk_fns = {
             b: jax.jit(partial(batched_chunk, kv_limit=b),
                        donate_argnums=(1, 2, 3))
             for b in self._kv_buckets
@@ -240,7 +242,7 @@ class BatchedJaxEngine(JaxEngine):
         )
         for kv_b in self._kv_buckets:
             toks, self._tok_d, self._pos_d, self._cache, self._key_d = (
-                self._chunk_fns[kv_b](
+                self._batch_chunk_fns[kv_b](
                     self.params, self._tok_d, self._pos_d, self._cache,
                     self._key_d, self._temps_d, jnp.zeros((N,), jnp.bool_))
             )
@@ -463,7 +465,7 @@ class BatchedJaxEngine(JaxEngine):
         needed = max(s.pos for s in active_slots) + self.chunk_len
         bucket = next(b for b in self._kv_buckets if b >= needed)
         toks_d, self._tok_d, self._pos_d, self._cache, self._key_d = (
-            self._chunk_fns[bucket](
+            self._batch_chunk_fns[bucket](
                 self.params, self._tok_d, self._pos_d, self._cache,
                 self._key_d, self._temps_d, active)
         )

@@ -1,15 +1,19 @@
 """Benchmark harness — one JSON line for the driver.
 
 Measures the headline metric from BASELINE.md: aggregate decode throughput
-(tokens/sec/chip) through the real serving path — continuous-batching
-scheduler, tokenize → jit prefill → pipelined jit decode chunks — plus
-single-stream TTFT, on whatever hardware is present:
+(tokens/sec/chip) through the REAL serving path — ``render_prompt`` (system
+prompt + query, exactly what /kubectl-command serves), prefix-KV cache
+active, continuous-batching scheduler, tokenize → jit prefill → pipelined
+jit decode chunks — plus single-stream TTFT on the same path:
 
 - TPU: Gemma-2B geometry (BASELINE config 2, v5e-1), random-init bf16 —
   identical compute/memory profile to real weights; weights' values don't
   affect throughput.
 - CPU fallback (no TPU in the environment): toy-8m geometry so the run
   finishes quickly; the JSON line still has the same schema.
+
+Throughput is the MEDIAN of 5 measured rounds (the chip shows ~2× run-to-
+run variance; best-of is not an honest statistic — VERDICT r2 weak #5).
 
 ``vs_baseline`` is value / 2000 tok/s/chip — the BASELINE.md north-star
 throughput target (the reference itself publishes no numbers; SURVEY.md §6).
@@ -19,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import statistics
 import sys
 import time
 
@@ -33,6 +38,7 @@ def log(msg: str) -> None:
 
 async def run_bench() -> dict:
     from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.engine.prompts import render_prompt
     from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
     from ai_agent_kubectl_tpu.models.config import get_config
 
@@ -40,10 +46,10 @@ async def run_bench() -> dict:
     n_chips = len(jax.devices())
     if platform == "tpu":
         model_name, dtype, max_tokens = "gemma-2b-it", "bfloat16", 64
-        batch_size, conc = 16, 16
+        batch_size, conc, rounds = 32, 32, 5
     else:
         model_name, dtype, max_tokens = "toy-8m", "float32", 32
-        batch_size, conc = 4, 4
+        batch_size, conc, rounds = 4, 4, 3
     log(f"bench: platform={platform} chips={n_chips} model={model_name} "
         f"bs={batch_size}")
 
@@ -52,8 +58,8 @@ async def run_bench() -> dict:
         cfg,
         tokenizer=ByteTokenizer(),
         dtype=dtype,
-        max_seq_len=512,
-        prefill_buckets=(64, 128),
+        max_seq_len=1024,
+        prefill_buckets=(64, 128, 256, 512),
         batch_size=batch_size,
         chunk_len=16,
     )
@@ -61,27 +67,46 @@ async def run_bench() -> dict:
     await engine.start()
     log(f"bench: engine ready in {time.monotonic() - t0:.1f}s")
 
-    prompt = "List all pods in the staging namespace with wide output"
-    # Warm-up covers compile of the generation bucket + decode chunk.
-    single = await engine.generate(prompt, max_tokens=8, temperature=0.0)
-    ttft_ms = single.ttft_ms
+    # The round-2 bench disabled the prefix cache and skipped the system
+    # prompt entirely; this bench serves the true /kubectl-command path and
+    # refuses to report numbers if the cache silently no-ops.
+    assert engine._prefix is not None, \
+        "prefix-KV cache must be active for the real serving path"
+    log(f"bench: prefix-KV cache ACTIVE ({engine._prefix.n} tokens resident)")
 
-    best = 0.0
-    for _ in range(3):
-        prompts = [f"list pods in namespace team-{i}" for i in range(conc)]
+    # Warm-up + single-stream TTFT on the true system-prompt path: the
+    # first iteration absorbs lazy warmup and is discarded; the reported
+    # figure is the median of the rest (same statistic as throughput).
+    ttfts = []
+    for i in range(4):
+        single = await engine.generate(
+            render_prompt(f"list pods in namespace warm-{i}"),
+            max_tokens=8, temperature=0.0,
+        )
+        assert single.prefix_cache_hit, "TTFT path must hit the prefix cache"
+        ttfts.append(single.ttft_ms)
+    ttft_ms = statistics.median(ttfts[1:])
+
+    samples = []
+    for r in range(rounds):
+        prompts = [
+            render_prompt(f"list pods in namespace team-{r}-{i}")
+            for i in range(conc)
+        ]
         t0 = time.monotonic()
         results = await asyncio.gather(*[
             engine.generate(p, max_tokens=max_tokens, temperature=0.0)
             for p in prompts
         ])
         dt = time.monotonic() - t0
-        total = sum(r.completion_tokens for r in results)
+        total = sum(r_.completion_tokens for r_ in results)
+        hits = sum(r_.prefix_cache_hit for r_ in results)
         tok_s = total / dt
-        best = max(best, tok_s)
+        samples.append(tok_s)
         log(f"bench: {total} tok across {conc} reqs in {dt:.2f}s = "
-            f"{tok_s:.0f} tok/s")
+            f"{tok_s:.0f} tok/s ({hits}/{conc} prefix hits)")
 
-    tok_s_chip = best / n_chips
+    tok_s_chip = statistics.median(samples) / n_chips
     await engine.stop()
     return {
         "metric": "aggregate_decode_tokens_per_sec_per_chip",
@@ -95,6 +120,10 @@ async def run_bench() -> dict:
             "dtype": dtype,
             "batch_size": batch_size,
             "concurrency": conc,
+            "rounds": rounds,
+            "statistic": "median",
+            "prefix_cache_active": True,
+            "prefix_tokens": engine._prefix.n,
             "single_stream_ttft_ms": round(ttft_ms, 2),
         },
     }

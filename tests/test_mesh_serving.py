@@ -70,7 +70,7 @@ async def test_batched_serving_dp_ep_tp_mesh_greedy_parity():
 
         # The *serving* decode-chunk program carries the EP all-to-alls.
         bucket = eng._kv_buckets[0]
-        lowered = eng._chunk_fns[bucket].lower(
+        lowered = eng._batch_chunk_fns[bucket].lower(
             eng.params, eng._tok_d, eng._pos_d, eng._cache, eng._key_d,
             eng._temps_d, jnp.zeros((eng.batch_size,), jnp.bool_),
         )
