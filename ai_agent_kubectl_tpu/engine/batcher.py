@@ -105,6 +105,7 @@ class BatchedJaxEngine(JaxEngine):
         self._admissions: _queue.Queue = _queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._running = False
+        self._group_admitted = 0   # batched group admissions served
 
     @classmethod
     def from_config(cls, cfg) -> "BatchedJaxEngine":
@@ -202,6 +203,8 @@ class BatchedJaxEngine(JaxEngine):
             return KVCache(k=k, v=v, lengths=lengths), tok, pos, temps
 
         self._splice_fn = jax.jit(splice, donate_argnums=(0, 3, 4, 5))
+        self._batch_admit_fns = {}   # (kind, *shape) -> jitted program
+        self._S_alloc = S_alloc
 
         # Device-side scheduler state. Under a serving mesh, slots shard
         # over ``data`` and KV heads over ``model`` (parallel/sharding.py);
@@ -246,6 +249,39 @@ class BatchedJaxEngine(JaxEngine):
                     self.params, self._tok_d, self._pos_d, self._cache,
                     self._key_d, self._temps_d, jnp.zeros((N,), jnp.bool_))
             )
+        # Warm the batched-admission programs for the expected hot shape
+        # (smallest suffix bucket) — bursts then admit without compiling.
+        if self._prefix is not None:
+            from .prefix_cache import round_kv_limit
+
+            sbucket = self.prefill_buckets[0]
+            kvl = round_kv_limit(self._prefix.n + sbucket, self.max_seq_len)
+            if kvl is not None:
+                spos = jnp.broadcast_to(
+                    self._prefix.n + jnp.arange(sbucket), (1, sbucket)
+                ).astype(jnp.int32)
+                for kpad in self.ADMIT_KPADS:
+                    scratch2 = self._new_cache(kpad, S_alloc)
+                    scratch2 = self._get_batch_prefix_splice_fn(kpad)(
+                        scratch2, self._prefix.k, self._prefix.v)
+                    ft, scratch2 = self._get_batch_suffix_fn(
+                        kpad, sbucket, kvl)(
+                        self.params, jnp.zeros((kpad, sbucket), jnp.int32),
+                        jnp.broadcast_to(spos, (kpad, sbucket)),
+                        scratch2, jnp.ones((kpad, sbucket), jnp.float32),
+                        jnp.ones((kpad,), jnp.int32), self._key_d,
+                        jnp.zeros((kpad,), jnp.float32),
+                    )
+                    # All rows out-of-bounds: exercises the program, splices
+                    # nothing.
+                    (self._cache, self._tok_d, self._pos_d,
+                     self._temps_d) = self._get_batch_splice_fn(kpad)(
+                        self._cache, scratch2.k, scratch2.v, self._tok_d,
+                        self._pos_d, self._temps_d,
+                        jnp.full((kpad,), N, jnp.int32),
+                        jnp.zeros((kpad,), jnp.int32), ft,
+                        jnp.zeros((kpad,), jnp.float32),
+                    )
         toks.block_until_ready()
 
         self._running = True
@@ -264,6 +300,7 @@ class BatchedJaxEngine(JaxEngine):
         if self._worker is not None:
             await asyncio.to_thread(self._worker.join, 10.0)
             self._worker = None
+        await super().stop()
 
     def stats(self) -> dict:
         """Live scheduler state for the /metrics gauges (scraped, not
@@ -313,15 +350,26 @@ class BatchedJaxEngine(JaxEngine):
             try:
                 self._admit_pending()
                 self._sweep_finishes()
-                dispatchable = any(
+                n_active = sum(
                     s is not None and not s.exhausted for s in self._slots
                 )
                 chunks_in_pipe = sum(
                     1 for e in self._inflight if e[0] == "chunk"
                 )
-                if dispatchable and chunks_in_pipe < 2:
+                # Latency mode at low occupancy: deliver a fresh admission's
+                # first token before launching speculative decode chunks —
+                # behind a high-RTT link the transfer otherwise queues
+                # behind a full chunk's compute (~TTFT + one chunk). With
+                # more streams active, throughput mode: keep the pipeline
+                # full and let transfers overlap.
+                if (chunks_in_pipe == 0 and n_active <= 2 and self._inflight
+                        and self._inflight[0][0] in ("first", "firsts")):
+                    self._consume_oldest()
+                    continue
+                if n_active > 0 and chunks_in_pipe < 2:
                     self._dispatch_chunk()
                     continue
+                self._prune_dead_chunks()
                 if self._inflight:
                     self._consume_oldest()
                     continue
@@ -353,13 +401,209 @@ class BatchedJaxEngine(JaxEngine):
                 break
             self._emit(req, "error", EngineUnavailable("engine stopped"))
 
+    #: batched-admission group sizes (pow2-padded); cap bounds the scratch
+    #: KV memory (kpad × S_alloc slots) and the compile variety.
+    ADMIT_KPADS = (2, 4, 8, 16)
+
     def _admit_pending(self) -> None:
-        while None in self._slots:
+        """Admit every queued request that fits a free slot. Requests on
+        the prefix-cache suffix path with the same (bucket, kv span) are
+        prefilled TOGETHER in one batched program — one read of the weights
+        for the whole burst instead of one per request, which is the
+        difference between ~640 ms and ~100 ms for a 32-request burst on a
+        2B model (round-3 profiling; also fixes round-2 weak #8's
+        admission-burst latency spike). Everything else (full prefill,
+        chunked/ring long prompts) takes the single-request path."""
+        free = sum(s is None for s in self._slots)
+        pending = []
+        while len(pending) < free:
             try:
-                req = self._admissions.get_nowait()
+                pending.append(self._admissions.get_nowait())
             except _queue.Empty:
-                return
+                break
+        if not pending:
+            return
+        groups: dict = {}
+        singles: List[_Request] = []
+        for req in pending:
+            key = self._suffix_group_key(req)
+            if key is None:
+                singles.append(req)
+            else:
+                groups.setdefault(key, []).append(req)
+        for (sbucket, kv_limit), reqs in groups.items():
+            while reqs:
+                take = reqs[:self.ADMIT_KPADS[-1]]
+                del reqs[:len(take)]
+                if len(take) == 1:
+                    self._admit_one(take[0])
+                else:
+                    self._admit_group(take, sbucket, kv_limit)
+        for req in singles:
             self._admit_one(req)
+
+    def _suffix_group_key(self, req: _Request):
+        """(sbucket, kv_limit) when this request will take the prefix-hit
+        suffix-prefill path, else None (single-request admission). Routing
+        delegates to the engine's _suffix_plan so grouped and single
+        admissions always agree."""
+        if self._prefix is None:
+            return None
+        ids = req.prompt_ids
+        max_prompt = self.max_seq_len - max(1, req.max_tokens)
+        if len(ids) > max_prompt or not self._prefix.matches(ids):
+            return None
+        plan = self._suffix_plan(ids)
+        if plan is None:
+            return None
+        sbucket, kv_limit, _ = plan
+        return (sbucket, kv_limit)
+
+    # ----- batched-admission programs (compiled per shape, cache-persisted)
+
+    def _get_batch_prefix_splice_fn(self, kpad: int):
+        key = ("prefix_splice", kpad)
+        fn = self._batch_admit_fns.get(key)
+        if fn is None:
+            def splice_prefix_batch(cache, pk, pv):
+                L, _, P = pk.shape[:3]
+                shape = (L, kpad, P) + pk.shape[3:]
+                k = jax.lax.dynamic_update_slice(
+                    cache.k, jnp.broadcast_to(pk, shape), (0, 0, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(
+                    cache.v, jnp.broadcast_to(pv, shape), (0, 0, 0, 0, 0))
+                lengths = jnp.full_like(cache.lengths, P)
+                return KVCache(k=k, v=v, lengths=lengths)
+
+            fn = jax.jit(splice_prefix_batch, donate_argnums=(0,))
+            self._batch_admit_fns[key] = fn
+        return fn
+
+    def _get_batch_suffix_fn(self, kpad: int, sbucket: int, kv_limit: int):
+        """forward over [kpad, sbucket] suffixes + per-row last-logit
+        gather + per-row first-token sample, one program."""
+        key = ("suffix", kpad, sbucket, kv_limit)
+        fn = self._batch_admit_fns.get(key)
+        if fn is None:
+            cfg = self.model_cfg
+            impl = self._prefill_impl_for(sbucket, kv_limit)
+
+            def batch_suffix(params, tokens, positions, cache, mask,
+                             lengths, key, temperatures):
+                logits, cache = forward(params, cfg, tokens, positions,
+                                        cache, kv_limit=kv_limit,
+                                        attn_impl=impl, mesh=self.mesh,
+                                        token_mask=mask)
+                last = jnp.take_along_axis(
+                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                first = sample_tokens_batched(last, key, temperatures)
+                return first, cache
+
+            fn = jax.jit(batch_suffix, donate_argnums=(3,))
+            self._batch_admit_fns[key] = fn
+        return fn
+
+    def _get_batch_splice_fn(self, kpad: int):
+        """Scatter kpad prefilled rows into their slots in one program.
+        Padding rows carry slot index == batch_size (out of bounds) and are
+        dropped by the scatter."""
+        key = ("splice", kpad)
+        fn = self._batch_admit_fns.get(key)
+        if fn is None:
+            def splice_many(cache, src_k, src_v, tok, pos, temps,
+                            slots, n_prompts, first_toks, temperatures):
+                k = cache.k.at[:, slots].set(src_k, mode="drop")
+                v = cache.v.at[:, slots].set(src_v, mode="drop")
+                lengths = cache.lengths.at[slots].set(n_prompts, mode="drop")
+                tok = tok.at[slots, 0].set(first_toks, mode="drop")
+                pos = pos.at[slots, 0].set(n_prompts, mode="drop")
+                temps = temps.at[slots].set(temperatures, mode="drop")
+                return KVCache(k=k, v=v, lengths=lengths), tok, pos, temps
+
+            fn = jax.jit(splice_many, donate_argnums=(0, 3, 4, 5))
+            self._batch_admit_fns[key] = fn
+        return fn
+
+    def _admit_group(self, reqs: List[_Request], sbucket: int,
+                     kv_limit: int) -> None:
+        """Batched admission: splice the resident prefix into kpad scratch
+        rows, prefill every suffix in ONE forward, sample all first tokens,
+        scatter the rows into their slots — zero host reads; the first
+        tokens travel as one ("firsts", vector) pipeline entry (one fetch
+        for the whole group)."""
+        live = []
+        for req in reqs:
+            if req.cancel.is_set():
+                continue
+            if req.deadline is not None and time.monotonic() > req.deadline:
+                self._emit(req, "error",
+                           GenerationTimeout("timed out waiting for a slot"))
+                continue
+            live.append(req)
+        if len(live) <= 1:
+            for req in live:
+                self._admit_one(req)
+            return
+        kpad = next(k for k in self.ADMIT_KPADS if k >= len(live))
+        prefix = self._prefix
+        t_adm = time.monotonic()
+
+        scratch = self._new_cache(kpad, self._S_alloc)
+        scratch = self._get_batch_prefix_splice_fn(kpad)(
+            scratch, prefix.k, prefix.v)
+
+        tokens = np.zeros((kpad, sbucket), np.int32)
+        mask = np.zeros((kpad, sbucket), np.float32)
+        suf_lens = np.ones((kpad,), np.int32)  # padding rows gather index 0
+        temps = np.zeros((kpad,), np.float32)
+        for i, req in enumerate(live):
+            suf = req.prompt_ids[prefix.n:]
+            tokens[i, :len(suf)] = suf
+            mask[i, :len(suf)] = 1.0
+            suf_lens[i] = len(suf)
+            temps[i] = req.temperature
+        positions = np.broadcast_to(
+            prefix.n + np.arange(sbucket), (kpad, sbucket)).astype(np.int32)
+
+        self._key_d, sub = jax.random.split(self._key_d)
+        first_toks_d, scratch = self._get_batch_suffix_fn(
+            kpad, sbucket, kv_limit)(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            scratch, jnp.asarray(mask), jnp.asarray(suf_lens), sub,
+            jnp.asarray(temps),
+        )
+
+        slots_arr = np.full((kpad,), self.batch_size, np.int32)  # OOB = drop
+        n_prompts = np.zeros((kpad,), np.int32)
+        pairs = []
+        for i, req in enumerate(live):
+            slot_idx = self._slots.index(None)
+            n_prompt = prefix.n + int(suf_lens[i])
+            slots_arr[i] = slot_idx
+            n_prompts[i] = n_prompt
+            self._slots[slot_idx] = _Slot(
+                req=req,
+                detok=StreamDecoder(self.tokenizer),
+                n_prompt=n_prompt,
+                pos=n_prompt,
+                queue_ms=(t_adm - req.t_submit) * 1000.0,
+                t_admit=t_adm,
+                t_decode0=t_adm,
+                chunks_inflight=1,
+                prefix_hit=True,
+            )
+            pairs.append((req, slot_idx))
+
+        self._cache, self._tok_d, self._pos_d, self._temps_d = (
+            self._get_batch_splice_fn(kpad)(
+                self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
+                self._temps_d, jnp.asarray(slots_arr),
+                jnp.asarray(n_prompts), first_toks_d, jnp.asarray(temps),
+            )
+        )
+        self._to_host_async(first_toks_d)
+        self._inflight.append(("firsts", first_toks_d, pairs))
+        self._group_admitted += 1
 
     def _admit_one(self, req: _Request) -> None:
         """Dispatch-only admission: prefill → device-side first-token
@@ -403,17 +647,23 @@ class BatchedJaxEngine(JaxEngine):
             prefix_hit=prefix_hit,
         )
         self._slots[slot_idx] = slot
+        # Start the device→host copy immediately: transfers overlap each
+        # other and device compute, so the blocking read at consume time
+        # finds the data already local. Behind a network tunnel this is THE
+        # difference between one RTT per admission burst and one RTT each
+        # (~100 ms serialized); on local PCIe it simply overlaps DMA.
+        self._to_host_async(first_tok_d)
         self._inflight.append(("first", first_tok_d, req, slot_idx))
 
-    def _consume_first(self, tok_d, req: _Request, slot_idx: int) -> None:
-        """Pull an admission's first token off the device and deliver it.
-        EOS / single-token finishes happen here; the slot's already-
-        dispatched decode chunks are then discarded via snapshot mismatch."""
+    def _consume_first(self, first_tok: int, req: _Request,
+                       slot_idx: int) -> None:
+        """Deliver an admission's first token (already fetched). EOS /
+        single-token finishes happen here; the slot's already-dispatched
+        decode chunks are then discarded via snapshot mismatch."""
         slot = self._slots[slot_idx]
         if slot is None or slot.req is not req:
             return  # finished/raced before its first token arrived
         slot.chunks_inflight -= 1
-        first_tok = int(np.asarray(tok_d)[0])
         now = time.monotonic()
         slot.t_first = now
         slot.t_decode0 = now
@@ -476,13 +726,37 @@ class BatchedJaxEngine(JaxEngine):
         for s in active_slots:
             s.pos += self.chunk_len
             s.chunks_inflight += 1
+        self._to_host_async(toks_d)   # overlap the transfer (see _admit_one)
         self._inflight.append(("chunk", toks_d, snapshot))
+
+    def _prune_dead_chunks(self) -> None:
+        """Drop leading chunk entries that carry tokens for no live slot —
+        e.g. the speculative chunks in flight when the last active request
+        finishes. Fetching them would block the scheduler ~a chunk's
+        compute + RTT each, which lands straight on the next request's
+        queue time (observed ~190 ms TTFT tax single-stream)."""
+        while self._inflight and self._inflight[0][0] == "chunk":
+            _, _, snapshot = self._inflight[0]
+            live = any(
+                snap is not None and self._slots[i] is not None
+                and self._slots[i].req is snap
+                for i, snap in enumerate(snapshot)
+            )
+            if live:
+                return
+            self._inflight.pop(0)
 
     def _consume_oldest(self) -> None:
         entry = self._inflight.pop(0)
         if entry[0] == "first":
             _, tok_d, req, slot_idx = entry
-            self._consume_first(tok_d, req, slot_idx)
+            self._consume_first(int(np.asarray(tok_d)[0]), req, slot_idx)
+            return
+        if entry[0] == "firsts":
+            _, toks_d, pairs = entry
+            vals = np.asarray(toks_d)  # one fetch for the whole group
+            for (req, slot_idx), v in zip(pairs, vals):
+                self._consume_first(int(v), req, slot_idx)
             return
         _, toks_d, snapshot = entry
         toks = np.asarray(toks_d)  # [N, chunk_len] — the per-chunk round trip

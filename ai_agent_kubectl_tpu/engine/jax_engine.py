@@ -114,6 +114,8 @@ class JaxEngine:
         self.tokenizer = tokenizer
         self.params = None
         self._ready = False
+        self._shutdown = False
+        self._ladder_thread: Optional[threading.Thread] = None
         self._lock: Optional[asyncio.Lock] = None
         self._prefill_fns = {}
         self._suffix_prefill_fns = {}  # (bucket, kv_limit) -> jitted prefill
@@ -178,9 +180,17 @@ class JaxEngine:
         ~80s of prefill/decode variants (VERDICT r2 weak #6)."""
         if not self.compile_cache_dir:
             return
+        # CPU compiles are fast and XLA:CPU AOT artifacts are brittle
+        # across flag/feature contexts (observed SIGILL-class crashes when
+        # a cached CPU executable is loaded under different XLA flags);
+        # the win is the TPU programs, so persist only off-CPU, isolated
+        # per platform.
+        if jax.default_backend() == "cpu":
+            return
         import os
 
-        path = os.path.expanduser(self.compile_cache_dir)
+        path = os.path.join(os.path.expanduser(self.compile_cache_dir),
+                            jax.default_backend())
         try:
             os.makedirs(path, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", path)
@@ -216,6 +226,18 @@ class JaxEngine:
                 f"only {len(devices)} present"
             )
         self.mesh = build_mesh(mesh_cfg, devices[:mesh_cfg.n_devices])
+
+    @staticmethod
+    def _to_host_async(arr) -> None:
+        """Start the device→host copy of ``arr`` without blocking. The
+        blocking read that eventually consumes it then finds the data
+        local. Behind a network tunnel this turns N serialized ~100 ms
+        round trips into one; on local PCIe it overlaps DMA with compute.
+        Best-effort: a backend without the API just pays at read time."""
+        try:
+            arr.copy_to_host_async()
+        except Exception:  # pragma: no cover - backend-dependent
+            pass
 
     def _new_cache(self, batch: int, max_seq: Optional[int] = None) -> KVCache:
         """Fresh KV cache, placed per the mesh policy when sharded serving
@@ -414,8 +436,10 @@ class JaxEngine:
             jnp.zeros((1, cfg.vocab_size), jnp.float32), key, temp0
         ).block_until_ready()
         toks.block_until_ready()
-        threading.Thread(target=self._warm_ladder_chunks,
-                         name="ladder-warm", daemon=True).start()
+        self._ladder_thread = threading.Thread(
+            target=self._warm_ladder_chunks, name="ladder-warm", daemon=True
+        )
+        self._ladder_thread.start()
         logger.info(
             "Engine ready: %s (%.1fM params, %s, buckets=%s) in %.1fs",
             cfg.name, cfg.param_count() / 1e6, np.dtype(self.dtype).name,
@@ -436,6 +460,8 @@ class JaxEngine:
             temp0 = jnp.asarray(0.0, jnp.float32)
             for kv_b in self._kv_buckets[:-1]:
                 for chunk_len in self.CHUNK_SIZES:
+                    if self._shutdown:
+                        return
                     fn = self._get_chunk_fn(chunk_len, kv_b)
                     _, _, _, cache, _, _ = fn(self.params, tok, pos, cache,
                                               key, temp0, jnp.asarray(False))
@@ -444,6 +470,12 @@ class JaxEngine:
 
     async def stop(self) -> None:
         self._ready = False
+        self._shutdown = True
+        if self._ladder_thread is not None:
+            # A compile in flight at interpreter teardown aborts the
+            # process; wait it out (flag stops the loop at the next shape).
+            await asyncio.to_thread(self._ladder_thread.join, 60.0)
+            self._ladder_thread = None
 
     # ----------------------------------------------------------- generate
 
@@ -570,19 +602,33 @@ class JaxEngine:
         # Next-token logits sit at the last *valid* prompt position.
         return logits[:, n_prompt - 1], cache, n_prompt, False
 
+    def _suffix_plan(self, prompt_ids):
+        """Static parameters of the suffix-prefill program for a prefix-
+        matched prompt: (sbucket, kv_limit, n_suffix), or None when the
+        suffix doesn't fit one bucket (chunked suffix path instead). THE
+        single source of suffix-path routing — the batcher's admission
+        grouping uses the same plan, so grouped and single admissions can
+        never diverge."""
+        from .prefix_cache import round_kv_limit
+
+        n_suffix = len(prompt_ids) - self._prefix.n
+        sbucket = next((b for b in self.prefill_buckets if b >= n_suffix),
+                       None)
+        if sbucket is None:
+            return None
+        kv_limit = round_kv_limit(self._prefix.n + sbucket, self.max_seq_len)
+        if kv_limit is None:
+            return None
+        return sbucket, kv_limit, n_suffix
+
     def _prefill_suffix(self, prompt_ids):
         """Prefix-cache hit path: splice the resident system-prompt KV,
         prefill only the suffix at offset positions. Returns the same tuple
         as _prefill_prompt, or None when no suffix program fits (caller
         falls back to full prefill)."""
-        from .prefix_cache import round_kv_limit
-
         prefix = self._prefix
-        suffix = prompt_ids[prefix.n:]
-        n_suffix = len(suffix)
-        sbucket = next((b for b in self.prefill_buckets if b >= n_suffix),
-                       None)
-        if sbucket is None:
+        plan = self._suffix_plan(prompt_ids)
+        if plan is None:
             # Suffix longer than the largest bucket: still reuse the
             # resident prefix KV, then consume the suffix in chunks.
             cache = self._new_cache(1)
@@ -590,9 +636,8 @@ class JaxEngine:
             logits, cache, n = self._prefill_chunked(prompt_ids, cache=cache,
                                                      start=prefix.n)
             return logits, cache, n, True
-        kv_limit = round_kv_limit(prefix.n + sbucket, self.max_seq_len)
-        if kv_limit is None:
-            return None
+        sbucket, kv_limit, n_suffix = plan
+        suffix = prompt_ids[prefix.n:]
         n_prompt = prefix.n + n_suffix
 
         cache = self._new_cache(1)
@@ -789,6 +834,7 @@ class JaxEngine:
                     toks_d, tok_d, pos_d, cache, key_d, done_d = fn(
                         self.params, tok_d, pos_d, cache, key_d, temp_d, done_d
                     )
+                    self._to_host_async(toks_d)
                     inflight.append(toks_d)
                     sched += chunk_len
                     sched_pos += chunk_len

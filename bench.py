@@ -46,7 +46,7 @@ async def run_bench() -> dict:
     n_chips = len(jax.devices())
     if platform == "tpu":
         model_name, dtype, max_tokens = "gemma-2b-it", "bfloat16", 64
-        batch_size, conc, rounds = 32, 32, 5
+        batch_size, conc, rounds = 64, 64, 5
     else:
         model_name, dtype, max_tokens = "toy-8m", "float32", 32
         batch_size, conc, rounds = 4, 4, 3

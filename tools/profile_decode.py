@@ -1,0 +1,188 @@
+"""Decode-step attribution profiler (VERDICT r2 item 3).
+
+Times each serving program in isolation on the current backend — the
+engine-identical batched decode chunk and its ablations, the admission
+prefill, the splice, sampling, the logits head, and the weight-read floor —
+so step time is attributed to compute classes instead of guessed at.
+
+Run on the bench chip:  python tools/profile_decode.py [--model gemma-2b-it]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from ai_agent_kubectl_tpu.engine.sampling import sample_tokens_batched  # noqa: E402
+from ai_agent_kubectl_tpu.models.config import get_config  # noqa: E402
+from ai_agent_kubectl_tpu.models.transformer import (  # noqa: E402
+    KVCache, forward, init_params,
+)
+
+
+def log(msg):
+    print(msg, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gemma-2b-it")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=10)
+    args = ap.parse_args()
+
+    cfg = get_config(args.model)
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[args.dtype]
+    log(f"profile: {cfg.name} on {jax.devices()[0].platform}, dtype={dtype.__name__}")
+
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    n_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
+    log(f"params: {n_bytes/1e9:.2f} GB")
+
+    # ---- weight-read floor: one pass over every param byte ----
+    @jax.jit
+    def read_weights(p):
+        return sum(jnp.sum(x).astype(jnp.float32)
+                   for x in jax.tree_util.tree_leaves(p))
+
+    t = timeit(lambda: read_weights(params), args.reps)
+    log(f"weight-read floor: {t:.2f} ms  ({n_bytes/1e9/t*1000:.0f} GB/s)")
+
+    S_alloc = 1024 + args.chunk
+
+    def make_chunk(N, kv_limit, sample: str):
+        """Engine-identical decode chunk with ablations.
+        sample: 'engine' (split+per-slot sampling) | 'argmax' (no RNG)."""
+
+        def chunk(params, tok, pos, cache, key, temps, active):
+            def body(carry, _):
+                tok, pos, cache, key = carry
+                logits, cache = forward(params, cfg, tok, pos, cache,
+                                        kv_limit=kv_limit, attn_impl="dense")
+                if sample == "engine":
+                    key, sub = jax.random.split(key)
+                    nxt = sample_tokens_batched(logits[:, 0], sub, temps)
+                else:
+                    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                nxt = jnp.where(active, nxt, tok[:, 0])
+                pos = pos + active.astype(jnp.int32)[:, None]
+                return (nxt[:, None], pos, cache, key), nxt
+
+            (tok, pos, cache, key), toks = jax.lax.scan(
+                body, (tok, pos, cache, key), None, length=args.chunk)
+            return jnp.swapaxes(toks, 0, 1), tok, pos, cache, key
+
+        return jax.jit(chunk, donate_argnums=(1, 2, 3))
+
+    def run_chunk(N, kv_limit, sample="engine", reps=args.reps):
+        fn = make_chunk(N, kv_limit, sample)
+        tok = jnp.zeros((N, 1), jnp.int32)
+        pos = jnp.full((N, 1), 320, jnp.int32)   # bench-realistic position
+        cache = KVCache.zeros(cfg, N, S_alloc, dtype=dtype)
+        key = jax.random.PRNGKey(0)
+        temps = jnp.zeros((N,), jnp.float32)
+        active = jnp.ones((N,), jnp.bool_)
+        toks, tok, pos, cache, key = fn(params, tok, pos, cache, key,
+                                        temps, active)   # compile
+        toks.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            toks, tok, pos, cache, key = fn(params, tok, pos, cache, key,
+                                            temps, active)
+        toks.block_until_ready()
+        ms = (time.perf_counter() - t0) / reps
+        return ms * 1000 / args.chunk  # per decode step
+
+    log("\n-- decode chunk: ms/step (engine-identical) --")
+    for N in (8, 16, 32, 64):
+        per = run_chunk(N, 512)
+        log(f"bs={N:3d} kv=512 : {per:7.2f} ms/step = "
+            f"{N/per*1000:6.0f} tok/s")
+
+    log("\n-- kv-span sweep at bs=32 --")
+    for kv in (128, 256, 512, S_alloc):
+        per = run_chunk(32, kv)
+        log(f"bs=32 kv={kv:5d}: {per:7.2f} ms/step = {32/per*1000:6.0f} tok/s")
+
+    log("\n-- ablations at bs=32 kv=512 --")
+    base = run_chunk(32, 512, "engine")
+    norng = run_chunk(32, 512, "argmax")
+    log(f"engine sampling : {base:7.2f} ms/step")
+    log(f"argmax, no RNG  : {norng:7.2f} ms/step  (sampling+rng = {base-norng:+.2f})")
+
+    # ---- standalone pieces ----
+    h = jax.random.normal(jax.random.PRNGKey(1), (32, cfg.dim), dtype)
+    embed = params["embed"]
+
+    @jax.jit
+    def head(h):
+        return (h @ embed.astype(h.dtype).T).astype(jnp.float32)
+
+    t = timeit(lambda: head(h), args.reps)
+    log(f"\nlogits head [32,{cfg.dim}]x[{cfg.vocab_size},{cfg.dim}]^T: {t:.2f} ms")
+
+    logits = jax.random.normal(jax.random.PRNGKey(2), (32, cfg.vocab_size),
+                               jnp.float32)
+    key = jax.random.PRNGKey(3)
+    temps0 = jnp.zeros((32,), jnp.float32)
+    samp = jax.jit(sample_tokens_batched)
+    t = timeit(lambda: samp(logits, key, temps0), args.reps)
+    log(f"sample_tokens_batched greedy [32,{cfg.vocab_size}]: {t:.2f} ms")
+
+    @jax.jit
+    def split(key):
+        return jax.random.split(key)
+
+    t = timeit(lambda: split(key), args.reps)
+    log(f"key split: {t:.2f} ms")
+
+    # ---- admission prefill (prefix-hit suffix: bucket 64 @ kv 384) ----
+    def prefill(params, tokens, positions, cache, mask):
+        return forward(params, cfg, tokens, positions, cache,
+                       kv_limit=384, attn_impl="dense", token_mask=mask)
+
+    pf = jax.jit(prefill, donate_argnums=(3,))
+    tokens = jnp.zeros((1, 64), jnp.int32)
+    positions = jnp.broadcast_to(273 + jnp.arange(64), (1, 64)).astype(jnp.int32)
+    mask = jnp.ones((1, 64), jnp.float32)
+    cache1 = KVCache.zeros(cfg, 1, 1024, dtype=dtype)
+    logits_pf, cache1 = pf(params, tokens, positions, cache1, mask)
+    logits_pf.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        logits_pf, cache1 = pf(params, tokens, positions, cache1, mask)
+    logits_pf.block_until_ready()
+    log(f"suffix prefill b64@kv384 B=1: "
+        f"{(time.perf_counter()-t0)/args.reps*1000:.2f} ms")
+
+    # ---- dispatch overhead: trivial jitted op round trip ----
+    @jax.jit
+    def nop(x):
+        return x + 1
+
+    x = jnp.zeros((8,), jnp.float32)
+    t = timeit(lambda: nop(x), 50)
+    log(f"trivial dispatch+sync round trip: {t:.2f} ms")
+
+
+def timeit(fn, reps):
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1000
+
+
+if __name__ == "__main__":
+    main()
