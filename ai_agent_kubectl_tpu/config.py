@@ -135,6 +135,11 @@ class ServiceConfig:
     prefill_buckets: str = "64,128,256,512,1024"  # PREFILL_BUCKETS (padded prefill shapes)
     temperature: float = 0.0                # TEMPERATURE (0 == greedy, matches app.py:109)
     attn_impl: str = "auto"                 # ATTN_IMPL: auto | dense | flash (prefill kernel)
+    # Decode attention: "paged" reads only each slot's live KV pages
+    # (ops/paged_attention.py) — opt-in for GQA models / ragged
+    # long-context batches with KV_PAGE_SIZE >= 64. "auto" resolves to
+    # dense-over-KV-bucket (faster on MQA-class models, measured).
+    decode_attn: str = "auto"               # DECODE_ATTN: auto | dense | paged
     kv_page_size: int = 16                  # KV_PAGE_SIZE (paged attention)
     hbm_prefix_cache: bool = True           # HBM_PREFIX_CACHE (system-prompt prefix KV)
     # Persistent XLA compilation cache: warm restarts skip the multi-second
@@ -198,6 +203,7 @@ class ServiceConfig:
             prefill_buckets=_env_str("PREFILL_BUCKETS", "64,128,256,512,1024"),
             temperature=_env_float("TEMPERATURE", 0.0),
             attn_impl=(_env_str("ATTN_IMPL", "auto") or "auto").lower(),
+            decode_attn=(_env_str("DECODE_ATTN", "auto") or "auto").lower(),
             kv_page_size=_env_int("KV_PAGE_SIZE", 16),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
             compile_cache_dir=os.getenv(

@@ -95,13 +95,19 @@ class BatchedJaxEngine(JaxEngine):
     name = "jax-batched"
 
     def __init__(self, *args, batch_size: int = 8, chunk_len: int = 8,
-                 kv_page_size: int = 16, **kwargs):
+                 kv_page_size: int = 16, decode_attn: str = "auto",
+                 **kwargs):
         super().__init__(*args, **kwargs)
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
+        if decode_attn not in ("auto", "dense", "paged"):
+            raise ValueError(
+                f"DECODE_ATTN must be auto|dense|paged, got {decode_attn!r}"
+            )
         self.batch_size = batch_size
         self.chunk_len = chunk_len
         self.kv_page_size = max(1, kv_page_size)
+        self.decode_attn = decode_attn
         self._admissions: _queue.Queue = _queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._running = False
@@ -124,6 +130,7 @@ class BatchedJaxEngine(JaxEngine):
             compile_cache_dir=cfg.compile_cache_dir,
             batch_size=cfg.decode_batch_size,
             kv_page_size=cfg.kv_page_size,
+            decode_attn=cfg.decode_attn,
         )
 
     # ------------------------------------------------------------ startup
@@ -145,16 +152,48 @@ class BatchedJaxEngine(JaxEngine):
         # writes stay < S + chunk_len by construction.
         S_alloc = S + self.chunk_len
 
+        # Decode attention impl: "paged" (ops/paged_attention.py) reads
+        # only each slot's live KV pages — true per-slot raggedness.
+        # auto resolves to dense: on the bench model (Gemma-2B, MQA)
+        # end-to-end paged measured 1,599 vs dense-ladder 2,584 tok/s —
+        # per-program grid overhead × n_layers outweighs the bandwidth
+        # saved when attention is ~6% of step time. Opt in explicitly for
+        # GQA models / very ragged long-context batches, with
+        # KV_PAGE_SIZE >= 64 (page 16 measured 47 ms/layer-call, grid-
+        # overhead-bound). Mesh-sharded paged decode is future work (the
+        # pallas call is not yet shard_mapped).
+        decode_impl = "dense" if self.decode_attn == "auto" else self.decode_attn
+        if decode_impl == "paged" and self.mesh is not None:
+            logger.warning("paged decode attention is not mesh-sharded yet; "
+                           "falling back to dense")
+            decode_impl = "dense"
+        if decode_impl == "paged" and jax.default_backend() == "tpu":
+            from ..ops.paged_attention import paged_supported
+
+            if not paged_supported(self.kv_page_size, cfg.head_dim, 1):
+                logger.warning(
+                    "paged decode unsupported for page=%d head_dim=%d on "
+                    "the compiled kernel; falling back to dense",
+                    self.kv_page_size, cfg.head_dim,
+                )
+                decode_impl = "dense"
+        self._decode_impl = decode_impl
+
         # Decode-attention cost grows with the KV span it reads. Rather
         # than attending over the full S_alloc cache every token (round-1:
         # cost ∝ max_seq even for 40-token sequences), the chunk program is
         # compiled per KV *bucket* — a pow2 ladder topped by S_alloc — and
         # dispatch picks the smallest bucket covering every live position.
         # All buckets are warmed at startup, so bucket growth never
-        # compiles mid-serving.
+        # compiles mid-serving. Paged decode needs no ladder: its cost
+        # tracks each slot's live pages inside one program.
         from .jax_engine import kv_bucket_ladder
 
-        self._kv_buckets = kv_bucket_ladder(S_alloc)
+        if decode_impl == "paged":
+            S_alloc = -(-S_alloc // self.kv_page_size) * self.kv_page_size
+            self._kv_buckets = (S_alloc,)
+        else:
+            self._kv_buckets = kv_bucket_ladder(S_alloc)
 
         def batched_chunk(params, tok, pos, cache, key, temps, active, *,
                           kv_limit):
@@ -166,9 +205,11 @@ class BatchedJaxEngine(JaxEngine):
             def body(carry, _):
                 tok, pos, cache, key = carry
                 logits, cache = forward(params, cfg, tok, pos, cache,
-                                        kv_limit=kv_limit, attn_impl="dense",
+                                        kv_limit=kv_limit,
+                                        attn_impl=self._decode_impl,
                                         mesh=self.mesh,
-                                        token_mask=active[:, None])
+                                        token_mask=active[:, None],
+                                        page_size=self.kv_page_size)
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens_batched(logits[:, 0], sub, temps)
                 nxt = jnp.where(active, nxt, tok[:, 0])
@@ -204,6 +245,7 @@ class BatchedJaxEngine(JaxEngine):
 
         self._splice_fn = jax.jit(splice, donate_argnums=(0, 3, 4, 5))
         self._batch_admit_fns = {}   # (kind, *shape) -> jitted program
+        self._batch_ready = set()    # (kpad, sbucket, kv_limit) compiled
         self._S_alloc = S_alloc
 
         # Device-side scheduler state. Under a serving mesh, slots shard
@@ -282,7 +324,15 @@ class BatchedJaxEngine(JaxEngine):
                         jnp.zeros((kpad,), jnp.int32), ft,
                         jnp.zeros((kpad,), jnp.float32),
                     )
+                    self._batch_ready.add((kpad, sbucket, kvl))
         toks.block_until_ready()
+        # Non-smallest suffix buckets compile in the background; group
+        # admissions for those shapes fall back to singles until then.
+        self._batch_warm_thread = threading.Thread(
+            target=self._warm_batch_admit_shapes, name="batch-admit-warm",
+            daemon=True,
+        )
+        self._batch_warm_thread.start()
 
         self._running = True
         self._worker = threading.Thread(
@@ -294,12 +344,56 @@ class BatchedJaxEngine(JaxEngine):
             cfg.name, N, self.chunk_len, time.monotonic() - t0,
         )
 
+    def _warm_batch_admit_shapes(self) -> None:
+        """Background-compile group-admission programs for the non-smallest
+        suffix buckets (the smallest is warmed eagerly at startup). Runs on
+        its own scratch state — never touches live scheduler buffers; each
+        shape is published to _batch_ready only after its first execution,
+        so the scheduler can never block on a half-compiled program."""
+        if self._prefix is None:
+            return
+        try:
+            from .prefix_cache import round_kv_limit
+
+            P = self._prefix.n
+            key = jax.random.PRNGKey(1)
+            for sbucket in self.prefill_buckets[1:]:
+                kvl = round_kv_limit(P + sbucket, self.max_seq_len)
+                if kvl is None:
+                    continue
+                spos = jnp.broadcast_to(
+                    P + jnp.arange(sbucket), (1, sbucket)).astype(jnp.int32)
+                for kpad in self.ADMIT_KPADS:
+                    if self._shutdown or not self._running:
+                        return
+                    scratch = self._new_cache(kpad, self._S_alloc)
+                    scratch = self._get_batch_prefix_splice_fn(kpad)(
+                        scratch, self._prefix.k, self._prefix.v)
+                    ft, scratch = self._get_batch_suffix_fn(
+                        kpad, sbucket, kvl)(
+                        self.params, jnp.zeros((kpad, sbucket), jnp.int32),
+                        jnp.broadcast_to(spos, (kpad, sbucket)),
+                        scratch, jnp.ones((kpad, sbucket), jnp.float32),
+                        jnp.ones((kpad,), jnp.int32), key,
+                        jnp.zeros((kpad,), jnp.float32),
+                    )
+                    ft.block_until_ready()
+                    self._batch_ready.add((kpad, sbucket, kvl))
+        except Exception:  # pragma: no cover - warm is best-effort
+            logger.exception("batch-admission warm failed; "
+                             "single-admission fallback stays")
+
     async def stop(self) -> None:
         self._ready = False
         self._running = False
+        self._shutdown = True
         if self._worker is not None:
             await asyncio.to_thread(self._worker.join, 10.0)
             self._worker = None
+        t = getattr(self, "_batch_warm_thread", None)
+        if t is not None:
+            await asyncio.to_thread(t.join, 60.0)
+            self._batch_warm_thread = None
         await super().stop()
 
     def stats(self) -> dict:
@@ -423,10 +517,27 @@ class BatchedJaxEngine(JaxEngine):
                 break
         if not pending:
             return
+        # Every request popped off the queue MUST reach either a slot or an
+        # error event — an exception mid-burst (e.g. OOM allocating the
+        # group scratch) may not silently drop the rest of the burst, or
+        # their generate() calls would block forever.
+        def guarded(admit, reqs):
+            try:
+                admit()
+            except Exception:
+                logger.exception("admission failed; failing %d request(s)",
+                                 len(reqs))
+                for req in reqs:
+                    self._emit(req, "error",
+                               EngineUnavailable("admission failed"))
+
         groups: dict = {}
         singles: List[_Request] = []
         for req in pending:
-            key = self._suffix_group_key(req)
+            try:
+                key = self._suffix_group_key(req)
+            except Exception:  # pragma: no cover - defensive
+                key = None
             if key is None:
                 singles.append(req)
             else:
@@ -436,11 +547,14 @@ class BatchedJaxEngine(JaxEngine):
                 take = reqs[:self.ADMIT_KPADS[-1]]
                 del reqs[:len(take)]
                 if len(take) == 1:
-                    self._admit_one(take[0])
+                    guarded(lambda: self._admit_one(take[0]), take)
                 else:
-                    self._admit_group(take, sbucket, kv_limit)
+                    guarded(
+                        lambda: self._admit_group(take, sbucket, kv_limit),
+                        take,
+                    )
         for req in singles:
-            self._admit_one(req)
+            guarded(lambda: self._admit_one(req), [req])
 
     def _suffix_group_key(self, req: _Request):
         """(sbucket, kv_limit) when this request will take the prefix-hit
@@ -545,6 +659,16 @@ class BatchedJaxEngine(JaxEngine):
                 self._admit_one(req)
             return
         kpad = next(k for k in self.ADMIT_KPADS if k >= len(live))
+        # Only fully-compiled shapes run the group path; a cold shape would
+        # compile a full model forward ON the scheduler thread and stall
+        # every active slot mid-serving ("admission never recompiles
+        # anything"). Until the background warm (_warm_batch_admit_shapes)
+        # lands a shape, fall back to single admissions — no worse than the
+        # pre-group-path behavior.
+        if (kpad, sbucket, kv_limit) not in self._batch_ready:
+            for req in live:
+                self._admit_one(req)
+            return
         prefix = self._prefix
         t_adm = time.monotonic()
 

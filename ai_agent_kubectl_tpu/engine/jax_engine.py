@@ -159,6 +159,7 @@ class JaxEngine:
         return self._ready
 
     async def start(self) -> None:
+        self._shutdown = False   # allow stop() → start() restarts
         await asyncio.to_thread(self._start_blocking)
         self._lock = asyncio.Lock()
         self._ready = True

@@ -153,7 +153,8 @@ def _moe_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray,
     return dense_moe(cfg, lp, x)
 
 
-def _layer(cfg: ModelConfig, attn_impl: str, mesh, h: jnp.ndarray, lp: Params,
+def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
+           h: jnp.ndarray, lp: Params,
            layer_k: jnp.ndarray, layer_v: jnp.ndarray,
            positions: jnp.ndarray, kv_limit: int,
            batch_idx: jnp.ndarray,
@@ -181,7 +182,16 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, h: jnp.ndarray, lp: Params,
     kv_pos = jnp.arange(kv_limit)[None, None, :]
     mask = kv_pos <= positions[:, :, None]
 
-    if attn_impl == "ring" and S > 1:
+    if attn_impl == "paged" and S == 1:
+        # Ragged decode: each slot reads only its live KV pages
+        # (ops/paged_attention.py); kv_limit is irrelevant — cost tracks
+        # positions per slot, not the bucket.
+        from ..ops.paged_attention import paged_decode_attention
+
+        attn = paged_decode_attention(
+            q[:, 0], layer_k, layer_v, positions[:, 0], page_size=page_size
+        )[:, None]
+    elif attn_impl == "ring" and S > 1:
         # Sequence-parallel self-attention over the chunk itself (no prior
         # cache context) — the from-scratch long-prefill path. K/V blocks
         # rotate over the ``seq`` mesh axis via ppermute; the cache write
@@ -218,6 +228,7 @@ def forward(
                                       # an "expert" axis >1 is present
     token_mask: Optional[jnp.ndarray] = None,  # [B, S]; 0 marks padding /
                                       # dead-slot tokens (MoE capacity)
+    page_size: int = 128,             # static: KV page for attn_impl="paged"
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run the model over a token chunk (prefill: S>1; decode: S=1).
 
@@ -235,8 +246,7 @@ def forward(
     if cfg.embed_scale:
         h = h * jnp.asarray(cfg.dim ** 0.5, h.dtype)
 
-    step = partial(_layer, cfg, "dense" if attn_impl == "dense" else attn_impl,
-                   mesh)
+    step = partial(_layer, cfg, attn_impl, mesh, page_size)
 
     def scan_body(h, xs):
         lp, layer_k, layer_v = xs

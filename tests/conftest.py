@@ -21,16 +21,21 @@ from pathlib import Path
 # on TPU "f32" matmuls run at bf16 MXU precision — numerics tests would
 # silently compare bf16 against themselves. jax.config.update after import
 # is the override that sticks.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# RUN_TPU_TESTS=1 opts out for the TPU-gated compiled-kernel parity tests
+# (tests/test_tpu_kernels.py) — run those ON the bench chip.
+_ON_TPU = os.environ.get("RUN_TPU_TESTS") == "1"
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 

@@ -1,0 +1,107 @@
+"""Paged decode attention parity vs dense (SURVEY.md §2.2 row 2): the
+kernel runs in interpret mode on CPU and must match dense_attention for
+ragged per-slot lengths, GQA and MQA, and page-boundary edge cases."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ai_agent_kubectl_tpu.ops.attention import dense_attention
+from ai_agent_kubectl_tpu.ops.paged_attention import paged_decode_attention
+
+
+def _dense_ref(q, k, v, positions):
+    """dense_attention over full caches with the decode causal mask."""
+    N, H, hd = q.shape
+    S = k.shape[1]
+    kv_pos = jnp.arange(S)[None, None, :]
+    mask = kv_pos <= positions[:, None, None]          # [N, 1, S]
+    return dense_attention(q[:, None], k, v, mask)[:, 0]
+
+
+def _rand(N, S, H, KV, hd, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (N, H, hd), dtype)
+    k = jax.random.normal(ks[1], (N, S, KV, hd), dtype)
+    v = jax.random.normal(ks[2], (N, S, KV, hd), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2])   # MQA and GQA
+def test_paged_matches_dense_ragged(kv_heads):
+    N, S, H, hd, page = 4, 128, 4, 64, 16
+    q, k, v = _rand(N, S, H, kv_heads, hd)
+    # Ragged lengths incl. page-boundary edges: 0 (single live token),
+    # exactly page-1, exactly page, mid-cache.
+    positions = jnp.asarray([0, 15, 16, 77], jnp.int32)
+    out = paged_decode_attention(q, k, v, positions, page_size=page,
+                                 interpret=True)
+    ref = _dense_ref(q, k, v, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_full_cache_and_last_page():
+    N, S, H, KV, hd, page = 2, 64, 4, 2, 64, 16
+    q, k, v = _rand(N, S, H, KV, hd, seed=1)
+    positions = jnp.asarray([S - 1, S - page], jnp.int32)
+    out = paged_decode_attention(q, k, v, positions, page_size=page,
+                                 interpret=True)
+    ref = _dense_ref(q, k, v, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_rejects_unaligned_cache():
+    q, k, v = _rand(2, 60, 4, 2, 64)
+    with pytest.raises(ValueError, match="divisible"):
+        paged_decode_attention(q, k, v, jnp.zeros((2,), jnp.int32),
+                               page_size=16, interpret=True)
+
+
+def test_paged_bf16_inputs():
+    N, S, H, KV, hd, page = 2, 64, 4, 1, 128, 16
+    q, k, v = _rand(N, S, H, KV, hd, seed=2, dtype=jnp.bfloat16)
+    positions = jnp.asarray([33, 5], jnp.int32)
+    out = paged_decode_attention(q, k, v, positions, page_size=page,
+                                 interpret=True)
+    ref = _dense_ref(q.astype(jnp.float32), k.astype(jnp.float32),
+                     v.astype(jnp.float32), positions)
+    np.testing.assert_allclose(np.asarray(out).astype(np.float32),
+                               np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+async def test_batched_engine_paged_decode_parity():
+    """The continuous-batching engine serving with DECODE_ATTN=paged
+    (interpret mode on CPU) produces exactly the dense-decode outputs, and
+    its slot caches pad to page multiples."""
+    import asyncio
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    def mk(decode_attn):
+        return BatchedJaxEngine(
+            get_config("toy-8m"), tokenizer=ByteTokenizer(), dtype="float32",
+            max_seq_len=64, prefill_buckets=(32,), prefix_cache=False,
+            batch_size=2, chunk_len=4, kv_page_size=16,
+            decode_attn=decode_attn)
+
+    texts = {}
+    for impl in ("dense", "paged"):
+        eng = mk(impl)
+        await eng.start()
+        try:
+            assert eng._decode_impl == impl
+            rs = await asyncio.gather(*[
+                eng.generate(p, max_tokens=6, temperature=0.0)
+                for p in ("list pods", "get nodes wide")
+            ])
+            texts[impl] = [r.text for r in rs]
+            if impl == "paged":
+                assert eng._cache.k.shape[2] % 16 == 0
+        finally:
+            await eng.stop()
+    assert texts["paged"] == texts["dense"]

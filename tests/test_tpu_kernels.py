@@ -1,0 +1,87 @@
+"""TPU-gated compiled-kernel parity tests (VERDICT r2 weak #7).
+
+The regular suite runs the Pallas kernels in interpret mode on CPU, which
+hides Mosaic tiling/layout regressions; these tests run the COMPILED
+kernels on a real chip against the dense reference.
+
+Run on the bench chip:  RUN_TPU_TESTS=1 python -m pytest tests/test_tpu_kernels.py -q
+(Skipped everywhere else.)
+"""
+
+import os
+
+import pytest
+
+_on_tpu = False
+if os.environ.get("RUN_TPU_TESTS") == "1":
+    import jax
+
+    _on_tpu = jax.default_backend() == "tpu"
+
+pytestmark = pytest.mark.skipif(
+    not _on_tpu,
+    reason="TPU-only: set RUN_TPU_TESTS=1 on a TPU host",
+)
+
+
+def _rand(shape, seed, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+def test_compiled_flash_matches_dense_prefill_shapes():
+    """Compiled Mosaic flash kernel vs dense on real bucket shapes
+    (suffix prefill b64 @ kv384 and full-bucket 256) — catches tiling
+    regressions the interpreter hides."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ai_agent_kubectl_tpu.ops.attention import dense_attention
+    from ai_agent_kubectl_tpu.ops.flash_attention import flash_attention_cached
+
+    for (S, KVLEN, off) in ((64, 384, 273), (256, 256, 0)):
+        B, H, KV, hd = 2, 8, 1, 256
+        q = _rand((B, S, H, hd), 0, jnp.bfloat16)
+        k = _rand((B, KVLEN, KV, hd), 1, jnp.bfloat16)
+        v = _rand((B, KVLEN, KV, hd), 2, jnp.bfloat16)
+        positions = jnp.broadcast_to(off + jnp.arange(S), (B, S)).astype(
+            jnp.int32)
+
+        out = flash_attention_cached(q, k, v, positions, interpret=False)
+
+        kv_pos = jnp.arange(KVLEN)[None, None, :]
+        mask = kv_pos <= positions[:, :, None]
+        ref = dense_attention(q, k, v, mask)
+        np.testing.assert_allclose(
+            np.asarray(out).astype(np.float32),
+            np.asarray(ref).astype(np.float32), rtol=3e-2, atol=3e-2)
+
+
+def test_compiled_paged_matches_dense_decode():
+    """Compiled paged decode kernel vs dense over the serving geometry
+    (64 slots, ragged lengths, MQA + GQA)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ai_agent_kubectl_tpu.ops.attention import dense_attention
+    from ai_agent_kubectl_tpu.ops.paged_attention import paged_decode_attention
+
+    for KV in (1, 2):
+        N, S, H, hd, page = 64, 1024, 8, 256, 128
+        q = _rand((N, H, hd), 3, jnp.bfloat16)
+        k = _rand((N, S, KV, hd), 4, jnp.bfloat16)
+        v = _rand((N, S, KV, hd), 5, jnp.bfloat16)
+        positions = jnp.asarray(
+            np.random.RandomState(0).randint(0, S, (N,)), jnp.int32)
+
+        out = paged_decode_attention(q, k, v, positions, page_size=page,
+                                     interpret=False)
+
+        kv_pos = jnp.arange(S)[None, None, :]
+        mask = kv_pos <= positions[:, None, None]
+        ref = dense_attention(q[:, None], k, v, mask)[:, 0]
+        np.testing.assert_allclose(
+            np.asarray(out).astype(np.float32),
+            np.asarray(ref).astype(np.float32), rtol=3e-2, atol=3e-2)
