@@ -142,6 +142,10 @@ class ServiceConfig:
     decode_attn: str = "auto"               # DECODE_ATTN: auto | dense | paged
     kv_page_size: int = 16                  # KV_PAGE_SIZE (paged attention)
     hbm_prefix_cache: bool = True           # HBM_PREFIX_CACHE (system-prompt prefix KV)
+    # Scheduler watchdog: if the batch scheduler makes no progress for this
+    # long while work is in flight (hung device dispatch), the engine is
+    # marked degraded and every waiting request is failed. 0 disables.
+    engine_watchdog_secs: float = 120.0     # ENGINE_WATCHDOG_SECS
     # Persistent XLA compilation cache: warm restarts skip the multi-second
     # per-program compiles (engine startup drops from ~80s to seconds).
     # Empty string disables.
@@ -206,6 +210,7 @@ class ServiceConfig:
             decode_attn=(_env_str("DECODE_ATTN", "auto") or "auto").lower(),
             kv_page_size=_env_int("KV_PAGE_SIZE", 16),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
+            engine_watchdog_secs=_env_float("ENGINE_WATCHDOG_SECS", 120.0),
             compile_cache_dir=os.getenv(
                 "COMPILE_CACHE_DIR", "~/.cache/ai-agent-kubectl-tpu/xla-cache"
             ),

@@ -96,6 +96,7 @@ class BatchedJaxEngine(JaxEngine):
 
     def __init__(self, *args, batch_size: int = 8, chunk_len: int = 8,
                  kv_page_size: int = 16, decode_attn: str = "auto",
+                 watchdog_secs: float = 120.0,
                  **kwargs):
         super().__init__(*args, **kwargs)
         if batch_size < 1:
@@ -108,10 +109,12 @@ class BatchedJaxEngine(JaxEngine):
         self.chunk_len = chunk_len
         self.kv_page_size = max(1, kv_page_size)
         self.decode_attn = decode_attn
+        self.watchdog_secs = watchdog_secs
         self._admissions: _queue.Queue = _queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._running = False
         self._group_admitted = 0   # batched group admissions served
+        self._last_progress = time.monotonic()
 
     @classmethod
     def from_config(cls, cfg) -> "BatchedJaxEngine":
@@ -127,10 +130,12 @@ class BatchedJaxEngine(JaxEngine):
             attn_impl=cfg.attn_impl,
             prefix_cache=cfg.hbm_prefix_cache,
             mesh_shape=cfg.mesh_shape,
+            dcn_mesh_shape=cfg.dcn_mesh_shape,
             compile_cache_dir=cfg.compile_cache_dir,
             batch_size=cfg.decode_batch_size,
             kv_page_size=cfg.kv_page_size,
             decode_attn=cfg.decode_attn,
+            watchdog_secs=cfg.engine_watchdog_secs,
         )
 
     # ------------------------------------------------------------ startup
@@ -339,6 +344,9 @@ class BatchedJaxEngine(JaxEngine):
             target=self._worker_loop, name="batch-scheduler", daemon=True
         )
         self._worker.start()
+        if self.watchdog_secs > 0:
+            threading.Thread(target=self._watchdog_loop, name="batch-watchdog",
+                             daemon=True).start()
         logger.info(
             "Batched engine ready: %s ×%d slots, chunk=%d, %.1fs",
             cfg.name, N, self.chunk_len, time.monotonic() - t0,
@@ -442,6 +450,7 @@ class BatchedJaxEngine(JaxEngine):
         self._inflight = []
         while self._running:
             try:
+                self._last_progress = time.monotonic()
                 self._admit_pending()
                 self._sweep_finishes()
                 n_active = sum(
@@ -522,6 +531,10 @@ class BatchedJaxEngine(JaxEngine):
         # group scratch) may not silently drop the rest of the burst, or
         # their generate() calls would block forever.
         def guarded(admit, reqs):
+            # Tick the watchdog per admission: a lazily-compiled admission
+            # shape can legitimately block for tens of seconds and must
+            # not read as a hung device.
+            self._last_progress = time.monotonic()
             try:
                 admit()
             except Exception:
@@ -853,6 +866,61 @@ class BatchedJaxEngine(JaxEngine):
         self._to_host_async(toks_d)   # overlap the transfer (see _admit_one)
         self._inflight.append(("chunk", toks_d, snapshot))
 
+    # ----------------------------------------------------------- watchdog
+
+    def _watchdog_loop(self) -> None:
+        """Detect a hung device dispatch (SURVEY.md §5 failure-detection
+        row): the scheduler thread blocks in a device read that never
+        completes, so every request — including ones with no client
+        timeout — would wait forever and /health would stay green. Checked
+        from a separate thread; fires once."""
+        interval = max(1.0, self.watchdog_secs / 4.0)
+        fired = False
+        while self._running:
+            time.sleep(interval)
+            if not fired:
+                fired = self._watchdog_check()
+            elif time.monotonic() - self._last_progress <= 2 * interval:
+                # The stall was transient (e.g. a giant one-off compile):
+                # the scheduler is ticking again. Already-failed requests
+                # stay failed, but new traffic can be served.
+                logger.warning("engine watchdog: scheduler progress "
+                               "resumed; re-marking engine ready")
+                self._ready = True
+                fired = False
+
+    def _watchdog_check(self) -> bool:
+        """One watchdog evaluation; returns True when it fired."""
+        busy = bool(self._inflight) or any(
+            s is not None for s in self._slots
+        )
+        if not busy:
+            self._last_progress = time.monotonic()
+            return False
+        if time.monotonic() - self._last_progress <= self.watchdog_secs:
+            return False
+        logger.critical(
+            "engine watchdog: no scheduler progress for %.0fs with work in "
+            "flight — marking engine degraded and failing %d slot(s)",
+            self.watchdog_secs,
+            sum(s is not None for s in self._slots),
+        )
+        self._ready = False
+        err = EngineUnavailable("engine watchdog: device dispatch hung")
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                # Host-side only: the scheduler thread owns the device
+                # state and is stuck; just unblock the waiting coroutines.
+                self._slots[i] = None
+                self._emit(slot.req, "error", err)
+        while True:
+            try:
+                req = self._admissions.get_nowait()
+            except _queue.Empty:
+                break
+            self._emit(req, "error", err)
+        return True
+
     def _prune_dead_chunks(self) -> None:
         """Drop leading chunk entries that carry tokens for no live slot —
         e.g. the speculative chunks in flight when the last active request
@@ -871,6 +939,7 @@ class BatchedJaxEngine(JaxEngine):
             self._inflight.pop(0)
 
     def _consume_oldest(self) -> None:
+        self._last_progress = time.monotonic()
         entry = self._inflight.pop(0)
         if entry[0] == "first":
             _, tok_d, req, slot_idx = entry

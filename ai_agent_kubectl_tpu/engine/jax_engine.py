@@ -85,6 +85,7 @@ class JaxEngine:
         attn_impl: str = "auto",
         prefix_cache: bool = True,
         mesh_shape: str = "",
+        dcn_mesh_shape: str = "",
         compile_cache_dir: str = "~/.cache/ai-agent-kubectl-tpu/xla-cache",
         seed: int = 0,
     ):
@@ -107,6 +108,7 @@ class JaxEngine:
         self.attn_impl = attn_impl
         self.use_prefix_cache = prefix_cache
         self.mesh_shape = mesh_shape
+        self.dcn_mesh_shape = dcn_mesh_shape
         self.mesh = None               # built in _start_blocking
         self.compile_cache_dir = compile_cache_dir
         self.seed = seed
@@ -149,6 +151,7 @@ class JaxEngine:
             attn_impl=cfg.attn_impl,
             prefix_cache=cfg.hbm_prefix_cache,
             mesh_shape=cfg.mesh_shape,
+            dcn_mesh_shape=cfg.dcn_mesh_shape,
             compile_cache_dir=cfg.compile_cache_dir,
         )
 
@@ -215,18 +218,21 @@ class JaxEngine:
         from ..parallel.mesh import MeshConfig, build_mesh
 
         spec = (self.mesh_shape or "").strip()
-        if not spec:
+        dcn_spec = (self.dcn_mesh_shape or "").strip()
+        if not spec and not dcn_spec:
             return
         mesh_cfg = MeshConfig.parse(spec)
-        if mesh_cfg.n_devices == 1:
+        dcn_cfg = MeshConfig.parse(dcn_spec) if dcn_spec else None
+        total = mesh_cfg.n_devices * (dcn_cfg.n_devices if dcn_cfg else 1)
+        if total == 1:
             return
         devices = jax.devices()
-        if mesh_cfg.n_devices > len(devices):
+        if total > len(devices):
             raise ValueError(
-                f"MESH_SHAPE={spec!r} wants {mesh_cfg.n_devices} devices; "
-                f"only {len(devices)} present"
+                f"MESH_SHAPE={spec!r} DCN_MESH_SHAPE={dcn_spec!r} wants "
+                f"{total} devices; only {len(devices)} present"
             )
-        self.mesh = build_mesh(mesh_cfg, devices[:mesh_cfg.n_devices])
+        self.mesh = build_mesh(mesh_cfg, devices[:total], dcn=dcn_cfg)
 
     @staticmethod
     def _to_host_async(arr) -> None:

@@ -34,19 +34,29 @@ def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    *,
+    require: bool = False,
 ) -> bool:
     """Initialize jax.distributed if configured. Returns True when running
-    multi-host, False for plain single-host operation. Idempotent."""
+    multi-host, False for plain single-host operation. Idempotent.
+
+    ``require=True`` (DISTRIBUTED_INIT=true) initializes even without a
+    coordinator address — on TPU pods JAX auto-configures the process
+    group from the runtime environment; silently skipping would leave
+    jax.devices() local and make the later DCN mesh build fail with a
+    confusing device-count error."""
     global _initialized
     if _initialized:
         return True
     coordinator_address = coordinator_address or os.getenv("COORDINATOR_ADDRESS")
-    if not coordinator_address:
+    if not coordinator_address and not require:
         return False
 
     import jax
 
-    kwargs = {"coordinator_address": coordinator_address}
+    kwargs = {}
+    if coordinator_address:
+        kwargs["coordinator_address"] = coordinator_address
     num_processes = num_processes or _int_env("NUM_PROCESSES")
     process_id = process_id if process_id is not None else _int_env("PROCESS_ID")
     if num_processes is not None:

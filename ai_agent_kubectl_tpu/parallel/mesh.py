@@ -76,22 +76,37 @@ class MeshConfig:
             or "single-device"
 
 
-def build_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
+def build_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None,
+               dcn: Optional[MeshConfig] = None) -> Mesh:
     """Build a Mesh with the canonical axis names.
 
     On TPU, ``mesh_utils.create_device_mesh`` lays logical axes onto the
     physical ICI torus (so per-layer TP collectives ride the fastest links);
     anywhere else (CPU emulation, single device) a reshape of
     ``jax.devices()`` is used.
+
+    ``dcn`` (DCN_MESH_SHAPE) adds a multi-slice outer factorization: each
+    logical axis sized ``ici × dcn``, with the dcn component crossing slice
+    boundaries via ``create_hybrid_device_mesh`` — collectives on an axis
+    with a dcn factor ride DCN, pure-ICI axes stay on-slice. Requires
+    ``jax.distributed`` to be up (process-sliced devices).
     """
     if devices is None:
         devices = jax.devices()
-    if cfg.n_devices != len(devices):
+    total = cfg.n_devices * (dcn.n_devices if dcn is not None else 1)
+    if total != len(devices):
         raise ValueError(
-            f"Mesh {cfg.describe()} wants {cfg.n_devices} devices, "
-            f"got {len(devices)}"
+            f"Mesh {cfg.describe()}"
+            + (f" × dcn {dcn.describe()}" if dcn is not None else "")
+            + f" wants {total} devices, got {len(devices)}"
         )
-    if devices[0].platform == "tpu" and len(devices) > 1:
+    if dcn is not None and dcn.n_devices > 1:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            cfg.shape, dcn.shape, devices=devices
+        )
+    elif devices[0].platform == "tpu" and len(devices) > 1:
         from jax.experimental import mesh_utils
 
         dev_array = mesh_utils.create_device_mesh(cfg.shape, devices=devices)

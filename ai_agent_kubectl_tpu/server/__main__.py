@@ -16,6 +16,20 @@ def main() -> None:
     logger = setup_logging(cfg.log_level)
     startup_warnings(cfg)
     logger.info("Config: %s", cfg.describe())
+    if cfg.distributed_init or cfg.coordinator_address:
+        # Multi-host (DCN) process group — must be up before any engine
+        # touches jax.devices() (SURVEY.md §5 distributed-comm row).
+        from ..parallel.distributed import init_distributed
+
+        # Explicit ranks only when multi-process is actually configured —
+        # on TPU pods JAX infers both from the runtime environment.
+        explicit = cfg.num_processes > 1
+        init_distributed(
+            cfg.coordinator_address,
+            cfg.num_processes if explicit else None,
+            cfg.process_id if explicit else None,
+            require=cfg.distributed_init,
+        )
     engine = build_engine(cfg)
     app = create_app(cfg, engine)
     logger.info("Starting server on %s:%s (engine=%s)", cfg.host, cfg.port, cfg.engine)

@@ -52,7 +52,7 @@ from .schemas import (
 logger = logging.getLogger(__name__)
 
 RATE_LIMITED_ROUTES = {"/kubectl-command", "/kubectl-command/stream", "/execute"}
-AUTH_ROUTES = RATE_LIMITED_ROUTES
+AUTH_ROUTES = RATE_LIMITED_ROUTES | {"/debug/trace"}
 
 
 def _client_key(request: web.Request) -> str:
@@ -349,6 +349,50 @@ async def handle_health(request: web.Request) -> web.Response:
     return web.json_response(body.model_dump(), status=200 if ready else 503)
 
 
+async def handle_debug_trace(request: web.Request) -> web.Response:
+    """POST /debug/trace?seconds=N — capture a jax.profiler device trace
+    while live traffic runs (SURVEY.md §5 tracing row; TensorBoard-
+    loadable). Auth-gated like the serving routes; one trace at a time;
+    only the newest few captures are retained."""
+    try:
+        seconds = min(max(float(request.query.get("seconds", 2.0)), 0.1), 30.0)
+    except ValueError:
+        return _json_error(400, "seconds must be a number")
+    if request.app.get("_tracing"):
+        return _json_error(409, "a trace is already in progress")
+    request.app["_tracing"] = True
+    try:
+        import os
+        import shutil
+        import tempfile
+
+        import jax
+
+        base = os.path.join(tempfile.gettempdir(),
+                            "ai-agent-kubectl-tpu-traces")
+        os.makedirs(base, exist_ok=True)
+        # Retention: traces are tens of MB; keep the newest 4 + this one.
+        old = sorted(
+            (d for d in os.listdir(base)
+             if os.path.isdir(os.path.join(base, d))),
+        )
+        for d in old[:-4] if len(old) > 4 else []:
+            shutil.rmtree(os.path.join(base, d), ignore_errors=True)
+        trace_dir = tempfile.mkdtemp(prefix=f"{time.strftime('%Y%m%d-%H%M%S')}-",
+                                     dir=base)
+        jax.profiler.start_trace(trace_dir)
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    except Exception as e:  # pragma: no cover - backend-dependent
+        logger.exception("trace capture failed")
+        return _json_error(500, f"trace capture failed: {e}")
+    finally:
+        request.app["_tracing"] = False
+    return web.json_response({"trace_dir": trace_dir, "seconds": seconds})
+
+
 async def handle_metrics(request: web.Request) -> web.Response:
     svc: Service = request.app["service"]
     # Engine gauges are sampled at scrape time (live scheduler state, not a
@@ -375,6 +419,7 @@ def create_app(cfg: ServiceConfig, engine: Engine,
     app.router.add_post("/kubectl-command", handle_kubectl_command)
     app.router.add_post("/kubectl-command/stream", handle_kubectl_command_stream)
     app.router.add_post("/execute", handle_execute)
+    app.router.add_post("/debug/trace", handle_debug_trace)
     app.router.add_get("/health", handle_health)
     app.router.add_get("/metrics", handle_metrics)
 

@@ -318,3 +318,25 @@ async def test_metrics_engine_gauges_sampled_at_scrape():
         assert "engine_kv_pages_total 256.0" in text
     finally:
         await client.close()
+
+
+async def test_debug_trace_endpoint():
+    """POST /debug/trace captures a jax.profiler trace (SURVEY.md §5
+    tracing row) and is auth-gated like the serving routes."""
+    client, _ = await make_client(make_cfg(api_auth_key="sekrit"))
+    try:
+        resp = await client.post("/debug/trace?seconds=0.1")
+        assert resp.status == 401  # auth-gated
+        resp = await client.post("/debug/trace?seconds=0.1",
+                                 headers={"X-API-Key": "sekrit"})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["seconds"] == 0.1
+        import os
+
+        assert os.path.isdir(body["trace_dir"])
+        resp = await client.post("/debug/trace?seconds=nope",
+                                 headers={"X-API-Key": "sekrit"})
+        assert resp.status == 400
+    finally:
+        await client.close()

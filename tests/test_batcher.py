@@ -218,3 +218,59 @@ async def test_group_admission_burst_parity():
         texts.append(text)
     assert texts == ref
     await eng.stop()
+
+
+async def test_watchdog_fails_hung_slots_and_degrades():
+    """A stalled scheduler (hung device dispatch) must not leave clients
+    blocked forever: the watchdog marks the engine degraded and fails
+    every active slot and queued admission (SURVEY.md §5 failure-detection
+    row)."""
+    import threading
+
+    from ai_agent_kubectl_tpu.engine.batcher import _Request, _Slot
+    from ai_agent_kubectl_tpu.engine.protocol import EngineUnavailable
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder
+
+    eng = BatchedJaxEngine(
+        get_config("toy-8m"), tokenizer=ByteTokenizer(), dtype="float32",
+        max_seq_len=64, prefill_buckets=(32,), prefix_cache=False,
+        batch_size=2, chunk_len=4, watchdog_secs=5.0)
+    await eng.start()
+    # Stop the real worker so the "hang" is fully simulated.
+    eng._running = False
+    await asyncio.to_thread(eng._worker.join, 30.0)
+    eng._worker = None
+    eng._ready = True
+
+    loop = asyncio.get_running_loop()
+
+    def mk_req():
+        return _Request(prompt_ids=[1, 2, 3], max_tokens=4, temperature=0.0,
+                        deadline=None, loop=loop, out_queue=asyncio.Queue(),
+                        cancel=threading.Event(), t_submit=time.monotonic())
+
+    active = mk_req()
+    queued = mk_req()
+    eng._slots[0] = _Slot(req=active, detok=StreamDecoder(eng.tokenizer),
+                          n_prompt=3, pos=3, queue_ms=0.0,
+                          t_admit=time.monotonic())
+    eng._inflight = [("chunk", None, [active, None])]
+    eng._admissions.put(queued)
+
+    # Fresh progress: must NOT fire.
+    eng._last_progress = time.monotonic()
+    assert eng._watchdog_check() is False
+    assert eng.ready
+
+    # Stale progress with work in flight: fires once.
+    eng._last_progress = time.monotonic() - 999.0
+    assert eng._watchdog_check() is True
+    assert not eng.ready
+    assert eng._slots[0] is None
+    await asyncio.sleep(0)  # deliver call_soon_threadsafe callbacks
+    for req in (active, queued):
+        event, payload = req.out_queue.get_nowait()
+        assert event == "error"
+        assert isinstance(payload, EngineUnavailable)
+    eng._inflight = []
+    await eng.stop()

@@ -147,3 +147,16 @@ def test_cache_specs_shard_kv_heads():
     specs = cache_specs(cfg)
     assert specs["k"] == P(None, "data", None, "model", None)
     assert specs["lengths"] == P("data")
+
+
+def test_hybrid_dcn_mesh_device_count_and_single_host_error():
+    """DCN_MESH_SHAPE is consumed: total devices = ici × dcn, and a hybrid
+    mesh on a single-process host fails fast (multi-slice needs
+    jax.distributed up)."""
+    with pytest.raises(ValueError, match="devices"):
+        build_mesh(MeshConfig.parse("tp=2"), devices=jax.devices()[:2],
+                   dcn=MeshConfig.parse("dp=2"))
+    with pytest.raises(Exception):
+        # 1 process cannot host a 2-slice hybrid mesh.
+        build_mesh(MeshConfig.parse("tp=2"), devices=jax.devices()[:4],
+                   dcn=MeshConfig.parse("dp=2"))
