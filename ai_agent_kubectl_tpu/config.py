@@ -129,6 +129,10 @@ class ServiceConfig:
 
     # --- engine knobs ---
     dtype: str = "bfloat16"                 # DTYPE
+    # Weight-only int8 quantization (ops/quant.py): halves projection
+    # weight bytes — decode is weight-read-bound, so near-proportional
+    # throughput for large dense models. "" disables.
+    quant: str = ""                         # QUANT: "" | int8
     max_seq_len: int = 1024                 # MAX_SEQ_LEN
     max_new_tokens: int = 128               # MAX_NEW_TOKENS
     decode_batch_size: int = 8              # DECODE_BATCH_SIZE (continuous batching slots)
@@ -201,6 +205,7 @@ class ServiceConfig:
             model_path=_env_str("MODEL_PATH", None),
             tokenizer_path=_env_str("TOKENIZER_PATH", None),
             dtype=_env_str("DTYPE", "bfloat16"),
+            quant=(_env_str("QUANT", "") or "").lower(),
             max_seq_len=_env_int("MAX_SEQ_LEN", 1024),
             max_new_tokens=_env_int("MAX_NEW_TOKENS", 128),
             decode_batch_size=_env_int("DECODE_BATCH_SIZE", 8),

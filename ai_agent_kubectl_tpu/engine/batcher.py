@@ -125,6 +125,7 @@ class BatchedJaxEngine(JaxEngine):
             model_path=cfg.model_path,
             tokenizer_path=cfg.tokenizer_path,
             dtype=cfg.dtype,
+            quant=cfg.quant,
             max_seq_len=cfg.max_seq_len,
             prefill_buckets=cfg.prefill_bucket_list,
             attn_impl=cfg.attn_impl,

@@ -80,6 +80,7 @@ class JaxEngine:
         model_path: Optional[str] = None,
         tokenizer_path: Optional[str] = None,
         dtype: str = "bfloat16",
+        quant: str = "",
         max_seq_len: int = 1024,
         prefill_buckets: tuple = (64, 128, 256, 512, 1024),
         attn_impl: str = "auto",
@@ -93,6 +94,9 @@ class JaxEngine:
         self.model_path = model_path
         self.tokenizer_path = tokenizer_path
         self.dtype = _dtype_from_str(dtype)
+        if quant not in ("", "int8"):
+            raise ValueError(f"QUANT must be '' or 'int8', got {quant!r}")
+        self.quant = quant
         self.max_seq_len = min(max_seq_len, model_cfg.max_seq_len)
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= self.max_seq_len
@@ -146,6 +150,7 @@ class JaxEngine:
             model_path=cfg.model_path,
             tokenizer_path=cfg.tokenizer_path,
             dtype=cfg.dtype,
+            quant=cfg.quant,
             max_seq_len=cfg.max_seq_len,
             prefill_buckets=cfg.prefill_bucket_list,
             attn_impl=cfg.attn_impl,
@@ -226,6 +231,15 @@ class JaxEngine:
         total = mesh_cfg.n_devices * (dcn_cfg.n_devices if dcn_cfg else 1)
         if total == 1:
             return
+        if mesh_cfg.pipe > 1 or (dcn_cfg is not None and dcn_cfg.pipe > 1):
+            # The serving engines run the layer stack via lax.scan; the
+            # pipelined forward (parallel/pipeline.py::pipeline_forward) is
+            # a tested library component not yet wired into the scheduler.
+            # Fail loudly rather than advertise a dead config.
+            raise ValueError(
+                "MESH_SHAPE pipe/pp axis is not supported by the serving "
+                "engines yet; use parallel.pipeline.pipeline_forward"
+            )
         devices = jax.devices()
         if total > len(devices):
             raise ValueError(
@@ -278,6 +292,13 @@ class JaxEngine:
                 self.params = init_params(
                     jax.random.PRNGKey(self.seed), self.model_cfg, dtype=self.dtype
                 )
+        if self.quant == "int8" and not getattr(self, "_quantized", False):
+            from ..ops.quant import quantize_params_int8
+
+            self.params = quantize_params_int8(self.params)
+            self._quantized = True
+            logger.info("Weights quantized to int8 (weight-only, "
+                        "per-channel scales)")
         if self.mesh is not None:
             from ..parallel.sharding import shard_params
 

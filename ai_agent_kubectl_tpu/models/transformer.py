@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import dense_attention
 from ..ops.norms import rms_norm
+from ..ops.quant import qmatmul
 from ..ops.rope import apply_rope
 from .config import ModelConfig
 
@@ -120,8 +121,8 @@ def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _dense_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
-    gate = _activation(cfg, x @ lp["w_gate"])
-    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+    gate = _activation(cfg, qmatmul(x, lp["w_gate"]))
+    return qmatmul(gate * qmatmul(x, lp["w_up"]), lp["w_down"])
 
 
 def _moe_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray,
@@ -164,9 +165,9 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     x = rms_norm(h, lp["attn_norm"], cfg.rms_eps, cfg.rms_offset)
-    q = (x @ lp["wq"]).reshape(B, S, H, hd)
-    k = (x @ lp["wk"]).reshape(B, S, KV, hd)
-    v = (x @ lp["wv"]).reshape(B, S, KV, hd)
+    q = qmatmul(x, lp["wq"]).reshape(B, S, H, hd)
+    k = qmatmul(x, lp["wk"]).reshape(B, S, KV, hd)
+    v = qmatmul(x, lp["wv"]).reshape(B, S, KV, hd)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
 
@@ -205,7 +206,7 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
         attn = flash_attention_cached(q, k_ctx, v_ctx, positions)
     else:
         attn = dense_attention(q, k_ctx, v_ctx, mask)
-    h = h + attn.reshape(B, S, H * hd) @ lp["wo"]
+    h = h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
 
     x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
     mlp = (_moe_mlp(cfg, lp, x, mesh, token_mask) if cfg.is_moe
@@ -260,7 +261,7 @@ def forward(
     if cfg.tie_embeddings:
         logits = h @ params["embed"].astype(h.dtype).T
     else:
-        logits = h @ params["lm_head"]
+        logits = qmatmul(h, params["lm_head"])
 
     new_lengths = jnp.maximum(cache.lengths, positions.max(axis=1) + 1)
     return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v, lengths=new_lengths)

@@ -1,0 +1,103 @@
+"""Weight-only int8 quantization (SURVEY.md §2.2 optional row, for the
+70B-class configs).
+
+Decode throughput on TPU is weight-read-bound (PROFILE.md: a bs=32 step
+runs at ~78% of the HBM weight-read floor), so halving weight bytes is a
+near-1.9× decode lever for large dense models. TPU-native design:
+
+- **Per-output-channel symmetric int8** for every projection matmul
+  (attention qkv/o, MLP gate/up/down; MoE expert weights included via the
+  same leaf type). Scales are f32, folded into the matmul epilogue —
+  ``(x @ w_q) * scale`` — which XLA fuses; the MXU reads int8 natively.
+- **Embeddings and norms stay in the model dtype**: the embedding gather
+  is row-wise (per-token), not a matmul, and norm weights are tiny.
+- ``QuantInt8`` is a registered pytree node, so the quantized param tree
+  flows through jit/donation/sharding unchanged; shard_params places the
+  int8 payload with the same PartitionSpec policy as the original weight
+  (scales follow the output-channel axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QuantInt8:
+    """Per-output-channel symmetric int8 weight.
+
+    q:     int8, same shape as the original weight
+    scale: f32, shape = broadcastable per-output-channel scales
+           (original shape with all but the last axis collapsed to 1)
+    """
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self):
+        return self.q.nbytes + self.scale.nbytes
+
+
+def quantize_int8(w: jnp.ndarray) -> QuantInt8:
+    """Symmetric int8, one scale per (batch..., output channel): only the
+    contraction axis (-2) is reduced, so stacked-layer weights [L, in, out]
+    get per-(layer, out) scales and lax.scan slices them per layer."""
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QuantInt8(q=q, scale=scale.astype(jnp.float32))
+
+
+def dequantize(w: QuantInt8, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
+
+
+def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
+    """x @ w for plain or QuantInt8 weights (w [in, out], scale [1, out]).
+    The dequant multiply sits in the matmul epilogue (one fused multiply
+    per output element)."""
+    if isinstance(w, QuantInt8):
+        y = jax.lax.dot_general(
+            x, w.q.astype(x.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+        )
+        # Scale multiply in f32, cast once: rounding the scales to the
+        # activation dtype first would add systematic per-channel error.
+        return (y.astype(jnp.float32) * w.scale[0]).astype(x.dtype)
+    return x @ w
+
+
+#: projection weights eligible for quantization (matmul RHS with the
+#: output channel last). Embeddings/norms/router excluded.
+_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params_int8(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize every dense projection matmul weight in the param tree
+    (models/transformer.py::init_params layout) to QuantInt8.
+
+    Stacked MoE expert weights (rank 4, [L, E, in, out]) are left in the
+    model dtype for now: their einsum dispatch paths would need a
+    dequantize-per-call, which re-materializes the full weight and defeats
+    the bandwidth win — the quantization target is the dense 70B configs.
+    """
+    out = dict(params)
+    layers = dict(params["layers"])
+    for key in _QUANT_KEYS:
+        if key in layers and layers[key].ndim == 3:
+            layers[key] = quantize_int8(layers[key])
+    out["layers"] = layers
+    if "lm_head" in params:
+        out["lm_head"] = quantize_int8(params["lm_head"])
+    return out

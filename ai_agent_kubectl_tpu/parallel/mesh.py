@@ -11,6 +11,7 @@ Axis conventions (fixed order, used by every PartitionSpec in the repo):
 
 - ``data``   — batch / DP.              all-reduce-free inference scaling
 - ``expert`` — MoE expert parallelism.  all-to-all dispatch/combine
+- ``pipe``   — pipeline stages (layer-stack sharding, ppermute hand-off)
 - ``seq``    — sequence/context (ring attention, long prefill)
 - ``model``  — tensor parallelism.      all-gather / reduce-scatter per layer
 
@@ -31,7 +32,7 @@ from jax.sharding import Mesh
 
 logger = logging.getLogger(__name__)
 
-AXES = ("data", "expert", "seq", "model")
+AXES = ("data", "expert", "pipe", "seq", "model")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,6 +41,7 @@ class MeshConfig:
 
     data: int = 1
     expert: int = 1
+    pipe: int = 1
     seq: int = 1
     model: int = 1
 
@@ -48,9 +50,9 @@ class MeshConfig:
         """Parse ``"dp=2,tp=4"`` / ``"data:2,model:4"`` style strings —
         ``=`` and ``:`` separators both accepted (the MESH_SHAPE env knob;
         empty string = single device)."""
-        alias = {"dp": "data", "ep": "expert", "sp": "seq", "tp": "model",
-                 "data": "data", "expert": "expert", "seq": "seq",
-                 "model": "model"}
+        alias = {"dp": "data", "ep": "expert", "pp": "pipe", "sp": "seq",
+                 "tp": "model", "data": "data", "expert": "expert",
+                 "pipe": "pipe", "seq": "seq", "model": "model"}
         kwargs = {}
         for part in filter(None, (p.strip() for p in spec.split(","))):
             key, _, val = part.replace(":", "=").partition("=")
@@ -58,14 +60,14 @@ class MeshConfig:
             if key not in alias:
                 raise ValueError(
                     f"Unknown mesh axis {key!r} in {spec!r}; "
-                    f"use dp/ep/sp/tp or {'/'.join(AXES)}"
+                    f"use dp/ep/pp/sp/tp or {'/'.join(AXES)}"
                 )
             kwargs[alias[key]] = int(val)
         return cls(**kwargs)
 
     @property
     def shape(self) -> tuple:
-        return (self.data, self.expert, self.seq, self.model)
+        return (self.data, self.expert, self.pipe, self.seq, self.model)
 
     @property
     def n_devices(self) -> int:

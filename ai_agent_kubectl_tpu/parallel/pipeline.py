@@ -1,0 +1,156 @@
+"""Pipeline parallelism: layer-stack sharding over the ``pipe`` mesh axis
+(SURVEY.md §2.4 PP row — config-gated, 70B multi-host).
+
+TPU-native GPipe-style collective pipelining, not a port of a
+rank-per-process PP runtime:
+
+- The stacked-layer param tree ([L, ...] leaves) and KV cache shard over
+  ``pipe`` on the layer axis — each stage holds L/n contiguous layers.
+  This is what makes a model that doesn't fit one device's HBM fit n.
+- Inside one ``shard_map`` program, hidden states flow stage→stage with
+  ``jax.lax.ppermute`` (neighbouring ICI hops); the batch is split into
+  microbatches so stages overlap work (classic GPipe schedule: at step t,
+  stage s processes microbatch t−s; fill+drain bubble = (n−1)/(n−1+M)).
+- Embedding and the LM head run outside the pipelined region (replicated);
+  the last stage's outputs are combined with a masked ``psum`` so every
+  device returns the same logits — SPMD in, SPMD out.
+
+Numerics match models/transformer.py::forward exactly (same _layer body);
+parity is tested on the 8-virtual-device CPU mesh (tests/test_pipeline.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.transformer import KVCache, _layer
+from ..ops.norms import rms_norm
+from ..ops.quant import qmatmul
+
+
+def _pipe_shard(lp, h_mb, pos_mb, k, v, *, cfg: ModelConfig, axis: str,
+                n_stages: int, n_micro: int, kv_limit: int, attn_impl: str):
+    """Per-stage body. lp leaves [L_local, ...]; h_mb [M, Bm, S, D]
+    (replicated); pos_mb [M, Bm, S]; k/v [L_local, B, S, KV, hd]."""
+    stage = jax.lax.axis_index(axis)
+    M, Bm, S, D = h_mb.shape
+    batch_idx = jnp.arange(Bm)[:, None]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    outs0 = jax.lax.pvary(jnp.zeros((M, Bm, S, D), h_mb.dtype), axis)
+    state0 = jax.lax.pvary(jnp.zeros((Bm, S, D), h_mb.dtype), axis)
+
+    def run_local_layers(h, positions, m_lo, k, v):
+        """Scan this stage's layers over microbatch rows [m_lo, m_lo+Bm)."""
+
+        def body(h, xs):
+            lp_l, k_l, v_l = xs
+            k_mb = jax.lax.dynamic_slice_in_dim(k_l, m_lo, Bm, axis=0)
+            v_mb = jax.lax.dynamic_slice_in_dim(v_l, m_lo, Bm, axis=0)
+            h, k_mb, v_mb = _layer(cfg, attn_impl, None, 128, h, lp_l,
+                                   k_mb, v_mb, positions, kv_limit,
+                                   batch_idx, None)
+            k_l = jax.lax.dynamic_update_slice_in_dim(k_l, k_mb, m_lo, 0)
+            v_l = jax.lax.dynamic_update_slice_in_dim(v_l, v_mb, m_lo, 0)
+            return h, (k_l, v_l)
+
+        h, (k, v) = jax.lax.scan(body, h, (lp, k, v))
+        return h, k, v
+
+    def step(t, carry):
+        outs, state, k, v = carry
+        m = t - stage
+        valid = (m >= 0) & (m < n_micro)
+        m_c = jnp.clip(m, 0, n_micro - 1)
+        h_in = jnp.where(stage == 0, h_mb[m_c], state)
+        positions = pos_mb[m_c]
+        h_out, k_new, v_new = run_local_layers(h_in, positions, m_c * Bm,
+                                               k, v)
+        # Invalid (bubble) iterations must not corrupt the cache or the
+        # output buffer — their writes land on the clamped microbatch.
+        k = jnp.where(valid, k_new, k)
+        v = jnp.where(valid, v_new, v)
+        outs = jnp.where(
+            valid & (stage == n_stages - 1),
+            jax.lax.dynamic_update_slice_in_dim(outs, h_out[None], m_c, 0),
+            outs,
+        )
+        state = jax.lax.ppermute(h_out, axis, perm)
+        return outs, state, k, v
+
+    outs, _, k, v = jax.lax.fori_loop(
+        0, n_stages + n_micro - 1, step, (outs0, state0, k, v)
+    )
+    # Only the last stage holds real outputs; everyone else contributes
+    # zeros — the psum broadcasts the result to all stages (SPMD out).
+    outs = jax.lax.psum(
+        jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)), axis
+    )
+    return outs, k, v
+
+
+def pipeline_forward(
+    params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,          # [B, S] int32
+    positions: jnp.ndarray,       # [B, S] int32 absolute positions
+    cache: KVCache,
+    mesh: Mesh,
+    *,
+    axis: str = "pipe",
+    microbatches: Optional[int] = None,
+    kv_limit: Optional[int] = None,
+    attn_impl: str = "dense",
+) -> Tuple[jnp.ndarray, KVCache]:
+    """forward() with the layer stack pipelined over ``axis``.
+
+    Same contract as models/transformer.py::forward. Requires n_layers and
+    the batch divisible by the stage count / microbatch count.
+    """
+    n_stages = mesh.shape[axis]
+    B, S = tokens.shape
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} must divide pipe stages {n_stages}"
+        )
+    M = microbatches or min(n_stages, B)
+    if B % M:
+        raise ValueError(
+            f"microbatch count {M} must divide the batch ({B})"
+        )
+    if kv_limit is None:
+        kv_limit = cache.max_seq
+
+    h = params["embed"][tokens]
+    if cfg.embed_scale:
+        h = h * jnp.asarray(cfg.dim ** 0.5, h.dtype)
+    Bm = B // M
+    h_mb = h.reshape(M, Bm, S, -1)
+    pos_mb = positions.reshape(M, Bm, S)
+
+    layer_specs = jax.tree_util.tree_map(lambda _: P(axis), params["layers"])
+    fn = jax.shard_map(
+        partial(_pipe_shard, cfg=cfg, axis=axis, n_stages=n_stages,
+                n_micro=M, kv_limit=kv_limit, attn_impl=attn_impl),
+        mesh=mesh,
+        in_specs=(layer_specs, P(), P(), P(axis), P(axis)),
+        out_specs=(P(), P(axis), P(axis)),
+    )
+    outs, new_k, new_v = fn(params["layers"], h_mb, pos_mb, cache.k, cache.v)
+    h = outs.reshape(B, S, -1)
+
+    h = rms_norm(h, params["final_norm"], cfg.rms_eps, cfg.rms_offset)
+    if cfg.tie_embeddings:
+        logits = h @ params["embed"].astype(h.dtype).T
+    else:
+        logits = qmatmul(h, params["lm_head"])
+
+    new_lengths = jnp.maximum(cache.lengths, positions.max(axis=1) + 1)
+    return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v,
+                                               lengths=new_lengths)

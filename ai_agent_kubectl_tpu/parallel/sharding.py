@@ -115,15 +115,29 @@ def token_spec() -> P:
 
 def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
     """device_put the param tree onto the mesh per the policy (with
-    divisibility sanitization per leaf)."""
+    divisibility sanitization per leaf). Int8-quantized weights
+    (ops/quant.py::QuantInt8) shard their payload with the original
+    weight's spec; the per-output-channel scales follow it (size-1 axes
+    sanitize to replicated, the channel axis inherits the sharding)."""
+    from ..ops.quant import QuantInt8
 
     specs = param_specs(cfg)
 
     def _put(leaf, spec):
+        if isinstance(leaf, QuantInt8):
+            return QuantInt8(
+                q=jax.device_put(leaf.q, NamedSharding(
+                    mesh, sanitize_spec(mesh, spec, leaf.q.shape))),
+                scale=jax.device_put(leaf.scale, NamedSharding(
+                    mesh, sanitize_spec(mesh, spec, leaf.scale.shape))),
+            )
         s = sanitize_spec(mesh, spec, leaf.shape)
         return jax.device_put(leaf, NamedSharding(mesh, s))
 
-    return jax.tree_util.tree_map(_put, params, specs)
+    return jax.tree_util.tree_map(
+        _put, params, specs,
+        is_leaf=lambda x: isinstance(x, QuantInt8),
+    )
 
 
 def shard_cache(cache, mesh: Mesh, cfg: ModelConfig):

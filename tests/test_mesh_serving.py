@@ -59,8 +59,8 @@ async def test_batched_serving_dp_ep_tp_mesh_greedy_parity():
     await eng.start()
     try:
         assert eng.mesh is not None
-        assert dict(eng.mesh.shape) == {"data": 2, "expert": 2, "seq": 1,
-                                        "model": 2}
+        assert dict(eng.mesh.shape) == {"data": 2, "expert": 2, "pipe": 1,
+                                        "seq": 1, "model": 2}
         # Params are actually distributed over all 8 devices, and the
         # attention projections are TP-sharded (not replicated everywhere).
         wq = eng.params["layers"]["wq"]

@@ -24,9 +24,9 @@ from ai_agent_kubectl_tpu.parallel.sharding import (
 
 def test_mesh_config_parse_aliases():
     cfg = MeshConfig.parse("dp=2,tp=4")
-    assert cfg.shape == (2, 1, 1, 4)
-    assert MeshConfig.parse("data=2, model=4").shape == (2, 1, 1, 4)
-    assert MeshConfig.parse("").shape == (1, 1, 1, 1)
+    assert cfg.shape == (2, 1, 1, 1, 4)
+    assert MeshConfig.parse("data=2, model=4").shape == (2, 1, 1, 1, 4)
+    assert MeshConfig.parse("").shape == (1, 1, 1, 1, 1)
     with pytest.raises(ValueError):
         MeshConfig.parse("bogus=2")
 

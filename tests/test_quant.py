@@ -1,0 +1,120 @@
+"""Weight-only int8 quantization (SURVEY.md §2.2 optional row): accuracy
+bounds, matmul-epilogue equivalence, sharded-tree placement, and the
+engine serving with QUANT=int8."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ai_agent_kubectl_tpu.ops.quant import (
+    QuantInt8, dequantize, qmatmul, quantize_int8, quantize_params_int8,
+)
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 32), jnp.float32)
+    qw = quantize_int8(w)
+    assert qw.q.dtype == jnp.int8
+    assert qw.scale.shape == (4, 1, 32)   # per-(layer, out-channel)
+    deq = dequantize(qw, jnp.float32)
+    # Symmetric 8-bit: error bounded by half a quantization step.
+    step = np.asarray(qw.scale)
+    assert np.all(np.abs(np.asarray(deq) - np.asarray(w)) <= step / 2 + 1e-7)
+
+
+def test_qmatmul_matches_dequant_matmul():
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 32), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 64), jnp.float32)
+    qw = quantize_int8(w)
+    out = qmatmul(x, qw)
+    ref = x @ dequantize(qw, jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # Plain weights pass through untouched.
+    np.testing.assert_allclose(np.asarray(qmatmul(x, w)), np.asarray(x @ w),
+                               rtol=1e-6)
+
+
+def test_quantize_params_skips_moe_and_small_leaves():
+    from ai_agent_kubectl_tpu.models.config import get_config
+    from ai_agent_kubectl_tpu.models.transformer import init_params
+
+    params = init_params(jax.random.PRNGKey(0), get_config("toy-moe"),
+                         dtype=jnp.float32)
+    qp = quantize_params_int8(params)
+    assert isinstance(qp["layers"]["wq"], QuantInt8)
+    # MoE expert weights (rank 4) stay in the model dtype.
+    assert not isinstance(qp["layers"]["w_gate"], QuantInt8)
+    assert not isinstance(qp["embed"], QuantInt8)
+    assert not isinstance(qp["layers"]["attn_norm"], QuantInt8)
+
+
+def test_quantized_forward_close_to_dequantized_reference():
+    from ai_agent_kubectl_tpu.models.config import get_config
+    from ai_agent_kubectl_tpu.models.transformer import (
+        KVCache, forward, init_params,
+    )
+
+    cfg = get_config("toy-8m")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    qp = quantize_params_int8(params)
+    deq = jax.tree_util.tree_map(
+        lambda x: dequantize(x, jnp.float32) if isinstance(x, QuantInt8) else x,
+        qp, is_leaf=lambda x: isinstance(x, QuantInt8))
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                cfg.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(8), (1, 8)).astype(jnp.int32)
+
+    lq, _ = forward(qp, cfg, tokens, positions, KVCache.zeros(cfg, 1, 16,
+                                                              jnp.float32))
+    lr, _ = forward(deq, cfg, tokens, positions, KVCache.zeros(cfg, 1, 16,
+                                                               jnp.float32))
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(lr),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_quantized_params_shard_over_tp_mesh():
+    from ai_agent_kubectl_tpu.models.config import get_config
+    from ai_agent_kubectl_tpu.models.transformer import (
+        KVCache, forward, init_params,
+    )
+    from ai_agent_kubectl_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ai_agent_kubectl_tpu.parallel.sharding import shard_cache, shard_params
+
+    cfg = get_config("toy-8m")
+    params = quantize_params_int8(
+        init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32))
+    mesh = build_mesh(MeshConfig.parse("tp=8"))
+    sp = shard_params(params, mesh, cfg)
+    wq = sp["layers"]["wq"]
+    assert wq.q.addressable_shards[0].data.shape[-1] == wq.q.shape[-1] // 8
+    assert wq.scale.addressable_shards[0].data.shape[-1] == \
+        wq.scale.shape[-1] // 8
+
+    cache = shard_cache(KVCache.zeros(cfg, 1, 16, jnp.float32), mesh, cfg)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(4), (1, 4)).astype(jnp.int32)
+    logits, _ = jax.jit(lambda p, t, pos, c: forward(p, cfg, t, pos, c))(
+        sp, tokens, positions, cache)
+    assert logits.shape == (1, 4, cfg.vocab_size)
+
+
+async def test_engine_serves_with_int8_quant():
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    eng = BatchedJaxEngine(
+        get_config("toy-8m"), tokenizer=ByteTokenizer(), dtype="float32",
+        quant="int8", max_seq_len=128, prefill_buckets=(32, 64),
+        prefix_cache=False, batch_size=2, chunk_len=4)
+    await eng.start()
+    try:
+        assert isinstance(eng.params["layers"]["wq"], QuantInt8)
+        r = await eng.generate("list pods", max_tokens=6, temperature=0.0)
+        assert r.completion_tokens >= 1
+        assert r.finish_reason in ("length", "stop")
+    finally:
+        await eng.stop()
