@@ -908,17 +908,24 @@ class BatchedJaxEngine(JaxEngine):
         )
         self._ready = False
         err = EngineUnavailable("engine watchdog: device dispatch hung")
-        for i, slot in enumerate(self._slots):
+        for slot in list(self._slots):
             if slot is not None:
-                # Host-side only: the scheduler thread owns the device
-                # state and is stuck; just unblock the waiting coroutines.
-                self._slots[i] = None
+                # Unblock the waiting coroutine, but leave _slots to the
+                # scheduler thread (it owns slot/device state). If the
+                # stall was a slow one-off rather than a true hang, a
+                # concurrently-resuming _admit_one could otherwise install
+                # a slot for an already-errored request and decode it to
+                # max_tokens into an abandoned queue (ADVICE r3).
+                # cancel.set() makes the resumed scheduler drop the request
+                # at its next sweep / admission check instead.
+                slot.req.cancel.set()
                 self._emit(slot.req, "error", err)
         while True:
             try:
                 req = self._admissions.get_nowait()
             except _queue.Empty:
                 break
+            req.cancel.set()
             self._emit(req, "error", err)
         return True
 

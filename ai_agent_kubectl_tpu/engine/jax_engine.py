@@ -127,6 +127,12 @@ class JaxEngine:
         self._suffix_prefill_fns = {}  # (bucket, kv_limit) -> jitted prefill
         self._ring_prefill_fns = {}    # S_pad -> jitted ring prefill
         self._chunk_fns = {}   # (chunk_len, kv_limit) -> jitted decode chunk
+        # Subset of _chunk_fns that has EXECUTED at least once (compile
+        # done). Dispatch consults only this dict, so a live request can
+        # never pick up a program the background ladder warm has built but
+        # not yet compiled and stall on its compile mid-request (the
+        # batcher's _batch_ready pattern, ADVICE r3 medium).
+        self._warm_chunk_fns = {}
         # Decode-attention cost tracks the live KV span, not max_seq:
         # dispatch picks the smallest ladder bucket covering the positions
         # a chunk can reach (kv_bucket_ladder; batcher has its own ladder
@@ -464,6 +470,11 @@ class JaxEngine:
             jnp.zeros((1, cfg.vocab_size), jnp.float32), key, temp0
         ).block_until_ready()
         toks.block_until_ready()
+        # Everything above has now compiled AND executed — publish the
+        # top-bucket programs for dispatch (the always-warm fallback).
+        for chunk_len in self.CHUNK_SIZES:
+            key_top = (chunk_len, self.max_seq_len)
+            self._warm_chunk_fns[key_top] = self._chunk_fns[key_top]
         self._ladder_thread = threading.Thread(
             target=self._warm_ladder_chunks, name="ladder-warm", daemon=True
         )
@@ -477,9 +488,12 @@ class JaxEngine:
     def _warm_ladder_chunks(self) -> None:
         """Background-compile the sub-top KV-ladder decode programs (one
         chunk of garbage decode each on scratch state — negligible device
-        time). Until a ladder variant lands, dispatch falls back to the
-        always-warm top-bucket program, which is numerically identical
-        (masked lanes contribute exact zeros), just wider."""
+        time). Each variant is published to ``_warm_chunk_fns`` only after
+        its first execution completes, so dispatch can never pick up a
+        still-cold program and block on its compile mid-request. Until a
+        variant lands, dispatch falls back to the always-warm top-bucket
+        program, which is numerically identical (masked lanes contribute
+        exact zeros), just wider."""
         try:
             cache = self._new_cache(1)
             tok = jnp.zeros((1, 1), jnp.int32)
@@ -491,8 +505,10 @@ class JaxEngine:
                     if self._shutdown:
                         return
                     fn = self._get_chunk_fn(chunk_len, kv_b)
-                    _, _, _, cache, _, _ = fn(self.params, tok, pos, cache,
-                                              key, temp0, jnp.asarray(False))
+                    toks, _, _, cache, _, _ = fn(self.params, tok, pos, cache,
+                                                 key, temp0, jnp.asarray(False))
+                    toks.block_until_ready()
+                    self._warm_chunk_fns[(chunk_len, kv_b)] = fn
         except Exception:  # pragma: no cover - warm is best-effort
             logger.exception("ladder warm failed; top-bucket fallback stays")
 
@@ -849,14 +865,15 @@ class JaxEngine:
                     if chunk_len == 0:
                         break  # KV capacity exhausted
                     # Smallest KV bucket covering every position this chunk
-                    # can reach: decode cost tracks the live span. Before
-                    # the background ladder warm lands, fall back to the
-                    # eagerly-warmed top bucket rather than compiling
-                    # mid-request.
+                    # can reach: decode cost tracks the live span. Only
+                    # EXECUTED programs (_warm_chunk_fns) are eligible —
+                    # before the background ladder warm lands a variant,
+                    # fall back to the eagerly-warmed top bucket rather
+                    # than compiling mid-request.
                     kv_b = next(b for b in self._kv_buckets
                                 if b >= sched_pos + chunk_len)
-                    fn = (self._chunk_fns.get((chunk_len, kv_b))
-                          or self._chunk_fns.get(
+                    fn = (self._warm_chunk_fns.get((chunk_len, kv_b))
+                          or self._warm_chunk_fns.get(
                               (chunk_len, self.max_seq_len))
                           or self._get_chunk_fn(chunk_len, kv_b))
                     toks_d, tok_d, pos_d, cache, key_d, done_d = fn(

@@ -122,6 +122,14 @@ def paged_decode_attention(
 
     Each slot reads only ``ceil((positions[n]+1)/page_size)`` KV pages.
     Requires S divisible by page_size (pad the cache allocation)."""
+    if pltpu is None:
+        # The grid spec and VMEM scratch below are TPU-pallas APIs even in
+        # interpret mode; without them the kernel cannot run anywhere.
+        raise NotImplementedError(
+            "paged_decode_attention requires jax.experimental.pallas.tpu "
+            "(unavailable in this JAX install); use the dense decode path "
+            "(DECODE_ATTN=dense)"
+        )
     N, H, hd = q.shape
     S, KV = k.shape[1], k.shape[2]
     if S % page_size:

@@ -8,7 +8,13 @@ near-1.9× decode lever for large dense models. TPU-native design:
 - **Per-output-channel symmetric int8** for every projection matmul
   (attention qkv/o, MLP gate/up/down; MoE expert weights included via the
   same leaf type). Scales are f32, folded into the matmul epilogue —
-  ``(x @ w_q) * scale`` — which XLA fuses; the MXU reads int8 natively.
+  ``(x @ w_q) * scale`` — which XLA fuses. ``qmatmul`` upcasts the int8
+  weight to the activation dtype before ``dot_general`` (the MXU computes
+  in bf16), so the bandwidth win depends on XLA fusing that convert into
+  the weight read — only int8 bytes may cross HBM, never a materialized
+  bf16 copy. Verified on TPU via the compiled-HLO check in
+  tests/test_tpu_kernels.py (the convert lands inside the dot's fusion)
+  and consistent with the measured end-to-end uplift (PROFILE.md).
 - **Embeddings and norms stay in the model dtype**: the embedding gather
   is row-wise (per-token), not a matmul, and norm weights are tiny.
 - ``QuantInt8`` is a registered pytree node, so the quantized param tree

@@ -254,45 +254,96 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
         ) + "\n"
         return frame.encode()
 
-    # Serve from the query→command cache when possible (same cache the
-    # non-streaming endpoint fills).
-    cached = svc.cache.cache.get(sanitized_query)
-    if cached is not None:
-        svc.metrics.cache_hits.inc()
-        await resp.write(sse(cached))
-        await resp.write(sse(cached, event="done"))
-        await resp.write_eof()
-        return resp
+    # Everything goes through the SAME cache + single-flight as the
+    # non-streaming endpoint (fixes the half-applied B4: concurrent
+    # identical streams no longer each run a full generation). The flight
+    # initiator streams tokens live; cache hits and coalesced waiters —
+    # streaming or not — replay the final command as one event. As with
+    # the non-streaming path, a disconnecting client does not cancel the
+    # shared generation: it completes and fills the cache (the documented
+    # SingleFlight semantics).
+    write_ok = True
 
-    pieces: list[str] = []
-    try:
-        stream = svc.engine.generate_stream(
-            render_prompt(sanitized_query),
-            max_tokens=svc.cfg.max_new_tokens,
-            temperature=svc.cfg.temperature,
-            timeout=svc.cfg.llm_timeout,
-        )
-        async for piece in stream:
-            pieces.append(piece)
-            await resp.write(sse(piece))
+    async def write_safe(frame: bytes) -> None:
+        nonlocal write_ok
+        if not write_ok:
+            return
         try:
-            command = parse_llm_output("".join(pieces))
-            svc.cache.cache.put(sanitized_query, command)
+            await resp.write(frame)
+        except Exception:
+            write_ok = False  # client went away mid-stream; stop writing
+
+    # The supplier never touches the socket — it hands tokens to this
+    # handler through a queue, and the handler writes them. A slow-reading
+    # client therefore stalls only its own drain loop, never the shared
+    # flight the coalesced waiters are blocked on.
+    _DONE = object()
+    token_q: asyncio.Queue = asyncio.Queue()
+
+    async def supplier() -> str:
+        pieces: list[str] = []
+        try:
+            stream = svc.engine.generate_stream(
+                render_prompt(sanitized_query),
+                max_tokens=svc.cfg.max_new_tokens,
+                temperature=svc.cfg.temperature,
+                timeout=svc.cfg.llm_timeout,
+            )
+            async for piece in stream:
+                pieces.append(piece)
+                token_q.put_nowait(piece)
+            return parse_llm_output("".join(pieces))
+        finally:
+            token_q.put_nowait(_DONE)
+
+    try:
+        flight = asyncio.ensure_future(
+            svc.cache.get_or_create(sanitized_query, supplier)
+        )
+        # Drain live tokens while the flight runs. Only our own supplier
+        # fills token_q; a cache hit or a coalesced flight leaves it empty
+        # and we just wait for the flight's result.
+        getter: Optional[asyncio.Future] = None
+        try:
+            while True:
+                getter = asyncio.ensure_future(token_q.get())
+                await asyncio.wait({getter, flight},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                if getter.done():
+                    piece = getter.result()
+                    if piece is _DONE:
+                        break
+                    await write_safe(sse(piece))
+                else:
+                    break  # flight finished without our supplier running
+        finally:
+            if getter is not None and not getter.done():
+                getter.cancel()
+        command, from_cache = await flight
+        if from_cache:
+            # A cache hit or another request's in-flight generation served
+            # us; our supplier never streamed — replay the result.
+            svc.metrics.cache_hits.inc()
+            await write_safe(sse(command))
+        else:
             svc.metrics.cache_misses.inc()
-            await resp.write(sse(command, event="done"))
-        except UnsafeCommandError as e:
-            svc.metrics.unsafe_commands.labels("llm").inc()
-            await resp.write(sse(str(e), event="error"))
+        await write_safe(sse(command, event="done"))
+    except UnsafeCommandError as e:
+        svc.metrics.unsafe_commands.labels("llm").inc()
+        await write_safe(sse(str(e), event="error"))
     except EngineUnavailable as e:
-        await resp.write(sse(f"engine unavailable: {e}", event="error"))
+        await write_safe(sse(f"engine unavailable: {e}", event="error"))
     except (GenerationTimeout, asyncio.TimeoutError):
-        await resp.write(sse("LLM request timed out", event="error"))
+        await write_safe(sse("LLM request timed out", event="error"))
     except Exception:
         # The 200 status is already on the wire; the best we can do is a
         # structured error event rather than a silently truncated stream.
         logger.exception("Stream generation failed for query '%s'", sanitized_query)
-        await resp.write(sse("internal error during generation", event="error"))
-    await resp.write_eof()
+        await write_safe(sse("internal error during generation", event="error"))
+    try:
+        await resp.write_eof()
+    except Exception:
+        pass  # client already gone; the stream is finished either way
     return resp
 
 

@@ -266,11 +266,17 @@ async def test_watchdog_fails_hung_slots_and_degrades():
     eng._last_progress = time.monotonic() - 999.0
     assert eng._watchdog_check() is True
     assert not eng.ready
-    assert eng._slots[0] is None
+    # Slot cleanup belongs to the scheduler thread (ADVICE r3): the
+    # watchdog only cancels the request — a scheduler that was merely slow
+    # drops it at its next sweep instead of decoding into a dead queue.
+    assert eng._slots[0] is not None
+    assert active.cancel.is_set()
+    assert queued.cancel.is_set()
     await asyncio.sleep(0)  # deliver call_soon_threadsafe callbacks
     for req in (active, queued):
         event, payload = req.out_queue.get_nowait()
         assert event == "error"
         assert isinstance(payload, EngineUnavailable)
+    eng._slots[0] = None
     eng._inflight = []
     await eng.stop()

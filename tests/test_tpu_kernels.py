@@ -85,3 +85,30 @@ def test_compiled_paged_matches_dense_decode():
         np.testing.assert_allclose(
             np.asarray(out).astype(np.float32),
             np.asarray(ref).astype(np.float32), rtol=3e-2, atol=3e-2)
+
+
+def test_int8_convert_fuses_into_weight_read():
+    """The int8→bf16 convert in qmatmul must fuse into the dot's weight
+    read — a materialized bf16 copy of the weight in the ENTRY computation
+    would forfeit the whole bandwidth win (ADVICE r3, ops/quant.py). The
+    check: no ENTRY-level instruction in the compiled HLO produces a bf16
+    tensor of the full weight shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from ai_agent_kubectl_tpu.ops.quant import qmatmul, quantize_int8
+
+    IN, OUT, B = 2048, 4096, 32
+    w = quantize_int8(_rand((IN, OUT), 7, jnp.float32))
+    x = _rand((B, IN), 8, jnp.bfloat16)
+
+    hlo = jax.jit(qmatmul).lower(x, w).compile().as_text()
+    entry = hlo.split("ENTRY")[-1]
+    materialized = [
+        line.strip() for line in entry.splitlines()
+        if f"= bf16[{IN},{OUT}]" in line and "parameter" not in line
+    ]
+    assert not materialized, (
+        "int8 weight convert materialized a full bf16 weight copy:\n"
+        + "\n".join(materialized)
+    )
