@@ -166,12 +166,17 @@ class BatchedJaxEngine(JaxEngine):
         # saved when attention is ~6% of step time. Opt in explicitly for
         # GQA models / very ragged long-context batches, with
         # KV_PAGE_SIZE >= 64 (page 16 measured 47 ms/layer-call, grid-
-        # overhead-bound). Mesh-sharded paged decode is future work (the
-        # pallas call is not yet shard_mapped).
+        # overhead-bound). Composes with data/model mesh axes (the pallas
+        # call is shard_mapped in models/transformer.py); only the pipe
+        # axis forces dense.
         decode_impl = "dense" if self.decode_attn == "auto" else self.decode_attn
-        if decode_impl == "paged" and self.mesh is not None:
-            logger.warning("paged decode attention is not mesh-sharded yet; "
-                           "falling back to dense")
+        if (decode_impl == "paged" and self.mesh is not None
+                and self.mesh.shape["pipe"] > 1):
+            # The pipelined layer path always runs dense attention (the
+            # pallas call doesn't compose with the pipe stage body); keep
+            # the KV ladder rather than the paged single-bucket setup.
+            logger.warning("paged decode attention does not compose with a "
+                           "pipe mesh axis; falling back to dense")
             decode_impl = "dense"
         if decode_impl == "paged" and jax.default_backend() == "tpu":
             from ..ops.paged_attention import paged_supported
@@ -308,7 +313,7 @@ class BatchedJaxEngine(JaxEngine):
                 spos = jnp.broadcast_to(
                     self._prefix.n + jnp.arange(sbucket), (1, sbucket)
                 ).astype(jnp.int32)
-                for kpad in self.ADMIT_KPADS:
+                for kpad in self.admit_kpads:
                     scratch2 = self._new_cache(kpad, S_alloc)
                     scratch2 = self._get_batch_prefix_splice_fn(kpad)(
                         scratch2, self._prefix.k, self._prefix.v)
@@ -372,7 +377,7 @@ class BatchedJaxEngine(JaxEngine):
                     continue
                 spos = jnp.broadcast_to(
                     P + jnp.arange(sbucket), (1, sbucket)).astype(jnp.int32)
-                for kpad in self.ADMIT_KPADS:
+                for kpad in self.admit_kpads:
                     if self._shutdown or not self._running:
                         return
                     scratch = self._new_cache(kpad, self._S_alloc)
@@ -509,6 +514,16 @@ class BatchedJaxEngine(JaxEngine):
     #: KV memory (kpad × S_alloc slots) and the compile variety.
     ADMIT_KPADS = (2, 4, 8, 16)
 
+    @property
+    def admit_kpads(self) -> tuple:
+        """Group sizes actually usable: a group can never exceed the free
+        slot count, so kpads beyond batch_size would only waste warm-up
+        compiles and scratch HBM (a 16-row scratch cache is ~4 GB on a
+        7B-geometry engine — real OOM risk at bs=8). Empty at
+        batch_size==1: the group path is structurally unreachable there
+        (a burst can never pop more than one free slot's worth)."""
+        return tuple(k for k in self.ADMIT_KPADS if k <= self.batch_size)
+
     def _admit_pending(self) -> None:
         """Admit every queued request that fits a free slot. Requests on
         the prefix-cache suffix path with the same (bucket, kv span) are
@@ -549,7 +564,8 @@ class BatchedJaxEngine(JaxEngine):
         singles: List[_Request] = []
         for req in pending:
             try:
-                key = self._suffix_group_key(req)
+                key = (self._suffix_group_key(req) if self.admit_kpads
+                       else None)
             except Exception:  # pragma: no cover - defensive
                 key = None
             if key is None:
@@ -558,7 +574,7 @@ class BatchedJaxEngine(JaxEngine):
                 groups.setdefault(key, []).append(req)
         for (sbucket, kv_limit), reqs in groups.items():
             while reqs:
-                take = reqs[:self.ADMIT_KPADS[-1]]
+                take = reqs[:self.admit_kpads[-1]]
                 del reqs[:len(take)]
                 if len(take) == 1:
                     guarded(lambda: self._admit_one(take[0]), take)
@@ -618,13 +634,16 @@ class BatchedJaxEngine(JaxEngine):
 
             def batch_suffix(params, tokens, positions, cache, mask,
                              lengths, key, temperatures):
+                # logits_at: the LM head projects ONLY each row's last
+                # valid position — a [kpad, sbucket, 256k-vocab] f32
+                # activation here measured as an HBM OOM on the 7B bench
+                # when the admission warm overlapped serving.
                 logits, cache = forward(params, cfg, tokens, positions,
                                         cache, kv_limit=kv_limit,
                                         attn_impl=impl, mesh=self.mesh,
-                                        token_mask=mask)
-                last = jnp.take_along_axis(
-                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-                first = sample_tokens_batched(last, key, temperatures)
+                                        token_mask=mask,
+                                        logits_at=lengths - 1)
+                first = sample_tokens_batched(logits[:, 0], key, temperatures)
                 return first, cache
 
             fn = jax.jit(batch_suffix, donate_argnums=(3,))
@@ -672,7 +691,7 @@ class BatchedJaxEngine(JaxEngine):
             for req in live:
                 self._admit_one(req)
             return
-        kpad = next(k for k in self.ADMIT_KPADS if k >= len(live))
+        kpad = next(k for k in self.admit_kpads if k >= len(live))
         # Only fully-compiled shapes run the group path; a cold shape would
         # compile a full model forward ON the scheduler thread and stall
         # every active slot mid-serving ("admission never recompiles

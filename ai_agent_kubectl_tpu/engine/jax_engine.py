@@ -237,14 +237,20 @@ class JaxEngine:
         total = mesh_cfg.n_devices * (dcn_cfg.n_devices if dcn_cfg else 1)
         if total == 1:
             return
-        if mesh_cfg.pipe > 1 or (dcn_cfg is not None and dcn_cfg.pipe > 1):
-            # The serving engines run the layer stack via lax.scan; the
-            # pipelined forward (parallel/pipeline.py::pipeline_forward) is
-            # a tested library component not yet wired into the scheduler.
-            # Fail loudly rather than advertise a dead config.
+        n_pipe = mesh_cfg.pipe * (dcn_cfg.pipe if dcn_cfg else 1)
+        if n_pipe > 1 and self.model_cfg.n_layers % n_pipe:
             raise ValueError(
-                "MESH_SHAPE pipe/pp axis is not supported by the serving "
-                "engines yet; use parallel.pipeline.pipeline_forward"
+                f"MESH_SHAPE pipe={n_pipe} does not divide "
+                f"{self.model_cfg.name}'s {self.model_cfg.n_layers} layers"
+            )
+        if n_pipe > 1 and self.model_cfg.is_moe and mesh_cfg.expert > 1:
+            # Inside a pipeline stage MoE layers evaluate densely (the EP
+            # all-to-all dispatch doesn't nest under the pipe shard_map):
+            # ~n_experts/top_k × the routed MLP FLOPs. Loud, not silent.
+            logger.warning(
+                "pipe>1 disables expert-parallel MoE dispatch: MoE layers "
+                "run dense (all experts) inside each pipeline stage; "
+                "prefer ep×tp without pp for MoE serving"
             )
         devices = jax.devices()
         if total > len(devices):
@@ -253,6 +259,18 @@ class JaxEngine:
                 f"{total} devices; only {len(devices)} present"
             )
         self.mesh = build_mesh(mesh_cfg, devices[:total], dcn=dcn_cfg)
+        if (n_pipe > 1 and jax.default_backend() == "cpu"
+                and self.dtype == jnp.bfloat16):
+            # XLA:CPU hard-aborts ("Invalid binary instruction opcode
+            # copy", hlo_instruction.cc) compiling the pipelined stage body
+            # with emulated bf16. CPU + pipe is a dev/emulation config
+            # only — force f32 there instead of crashing the process; on
+            # TPU bf16 is native and unaffected.
+            logger.warning(
+                "CPU emulation of a pipe mesh cannot compile bf16; "
+                "forcing float32 params for this dev configuration"
+            )
+            self.dtype = jnp.float32
 
     @staticmethod
     def _to_host_async(arr) -> None:
@@ -295,9 +313,24 @@ class JaxEngine:
                     "No MODEL_PATH; random-initializing %s (toy/dev mode)",
                     self.model_cfg.name,
                 )
-                self.params = init_params(
-                    jax.random.PRNGKey(self.seed), self.model_cfg, dtype=self.dtype
-                )
+                if self.quant == "int8":
+                    # A 7B-class bf16 init (~17 GB) would OOM the chip
+                    # before quantization ever runs; init directly in int8
+                    # on device (ops/quant.py::random_params_int8 — same
+                    # tree structure/shapes as a quantized checkpoint, no
+                    # full-precision materialization anywhere).
+                    from ..ops.quant import random_params_int8
+
+                    self.params = random_params_int8(
+                        jax.random.PRNGKey(self.seed), self.model_cfg,
+                        dtype=self.dtype,
+                    )
+                    self._quantized = True
+                else:
+                    self.params = init_params(
+                        jax.random.PRNGKey(self.seed), self.model_cfg,
+                        dtype=self.dtype,
+                    )
         if self.quant == "int8" and not getattr(self, "_quantized", False):
             from ..ops.quant import quantize_params_int8
 
@@ -334,10 +367,13 @@ class JaxEngine:
 
         def prefill(params, tokens, positions, cache, mask, *, kv_limit, impl):
             # mask [1, bucket]: 1 for prompt tokens, 0 for bucket padding —
-            # padding must never consume MoE expert capacity.
+            # padding must never consume MoE expert capacity. Its row sums
+            # also locate the last valid token, so the LM head projects
+            # only that position ([B, 1, vocab] out — see forward()).
+            last = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
             return forward(params, cfg, tokens, positions, cache,
                            kv_limit=kv_limit, attn_impl=impl, mesh=self.mesh,
-                           token_mask=mask)
+                           token_mask=mask, logits_at=last)
 
         self._prefill_raw = prefill
         for b in self.prefill_buckets:
@@ -644,7 +680,7 @@ class JaxEngine:
         cache = KVCache(k=cache.k, v=cache.v,
                         lengths=jnp.full((1,), n_prompt, jnp.int32))
         # Next-token logits sit at the last *valid* prompt position.
-        return logits[:, n_prompt - 1], cache, n_prompt, False
+        return logits[:, 0], cache, n_prompt, False
 
     def _suffix_plan(self, prompt_ids):
         """Static parameters of the suffix-prefill program for a prefix-
@@ -698,7 +734,7 @@ class JaxEngine:
         )
         cache = KVCache(k=cache.k, v=cache.v,
                         lengths=jnp.full((1,), n_prompt, jnp.int32))
-        return logits[:, n_suffix - 1], cache, n_prompt, True
+        return logits[:, 0], cache, n_prompt, True
 
     def _prefill_chunked(self, prompt_ids, cache=None, start: int = 0):
         """Sequential multi-bucket prefill at absolute offsets: consume the
@@ -741,7 +777,7 @@ class JaxEngine:
             offset += L
         cache = KVCache(k=cache.k, v=cache.v,
                         lengths=jnp.full((1,), n, jnp.int32))
-        return logits[:, L - 1], cache, n
+        return logits[:, 0], cache, n
 
     def _get_ring_prefill_fn(self, s_pad: int):
         """Jitted sequence-parallel prefill over the ``seq`` mesh axis
@@ -752,9 +788,11 @@ class JaxEngine:
             cfg = self.model_cfg
 
             def ring_prefill(params, tokens, positions, cache, mask):
+                last = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
                 return forward(params, cfg, tokens, positions, cache,
                                kv_limit=s_pad, attn_impl="ring",
-                               mesh=self.mesh, token_mask=mask)
+                               mesh=self.mesh, token_mask=mask,
+                               logits_at=last)
 
             fn = jax.jit(ring_prefill, donate_argnums=(3,))
             self._ring_prefill_fns[s_pad] = fn
@@ -790,7 +828,7 @@ class JaxEngine:
         )
         cache = KVCache(k=cache.k, v=cache.v,
                         lengths=jnp.full((1,), n, jnp.int32))
-        return logits[:, n - 1], cache, n, False
+        return logits[:, 0], cache, n, False
 
     def _generate_blocking(self, prompt: str, max_tokens: int,
                            temperature: float, deadline: Optional[float],

@@ -89,6 +89,64 @@ def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
 _QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
+def random_params_int8(key, cfg, dtype=None) -> Dict[str, Any]:
+    """Random-init a param tree DIRECTLY in quantized form — no
+    full-precision materialization anywhere (a 7B bf16 init is ~17 GB:
+    HBM OOM before quantization could run, and a host-side init pays
+    minutes of CPU PRNG plus a ~10 GB transfer). Bench/dev only: weight
+    VALUES are arbitrary (same as any random init), but the tree
+    structure, shapes, and dtypes match
+    ``quantize_params_int8(init_params(...))`` exactly — every jitted
+    serving program compiles identically to a real int8 checkpoint.
+    """
+    import jax.numpy as _jnp
+
+    from ..models.transformer import init_params
+
+    if dtype is None:
+        dtype = _jnp.bfloat16
+    shapes = jax.eval_shape(lambda k: init_params(k, cfg, dtype=dtype), key)
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+    keys = jax.random.split(key, len(leaves))
+    out = []
+    for (path, sds), k in zip(leaves, keys):
+        name = path[-1].key
+        quantized = ((name in _QUANT_KEYS and len(sds.shape) == 3)
+                     or name == "lm_head")
+        if quantized:
+            # Per-layer generation: the PRNG materializes uint32 bits
+            # (4 B/element) before the int8 convert, so one call over a
+            # stacked 7B MLP leaf ([28, 3072, 24576]) would transiently
+            # need ~8.5 GB — an OOM on its own. Layer slices keep the
+            # transient at 1/L of that; the stack is pure int8.
+            if len(sds.shape) == 3:
+                lk = jax.random.split(k, sds.shape[0])
+                q = _jnp.stack([
+                    jax.random.randint(lk[i], sds.shape[1:], -127, 128,
+                                       dtype=_jnp.int8)
+                    for i in range(sds.shape[0])
+                ])
+            else:
+                q = jax.random.randint(k, sds.shape, -127, 128,
+                                       dtype=_jnp.int8)
+            sshape = tuple(1 if i == len(sds.shape) - 2 else s
+                           for i, s in enumerate(sds.shape))
+            # Plausible magnitude: absmax ≈ the init scale init_params uses.
+            scale = _jnp.full(sshape, (sds.shape[-2] ** -0.5) / 127.0,
+                              _jnp.float32)
+            out.append(QuantInt8(q=q, scale=scale))
+        elif name.endswith("norm"):
+            fill = _jnp.zeros if cfg.rms_offset else _jnp.ones
+            out.append(fill(sds.shape, dtype))
+        else:
+            scale = 1.0 if name == "embed" else sds.shape[0] ** -0.5
+            out.append(
+                (jax.random.normal(k, sds.shape, _jnp.float32) * scale)
+                .astype(dtype)
+            )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def quantize_params_int8(params: Dict[str, Any]) -> Dict[str, Any]:
     """Quantize every dense projection matmul weight in the param tree
     (models/transformer.py::init_params layout) to QuantInt8.

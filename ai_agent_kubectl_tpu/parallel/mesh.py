@@ -105,8 +105,16 @@ def build_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None,
     if dcn is not None and dcn.n_devices > 1:
         from jax.experimental import mesh_utils
 
+        # TPU multi-slice devices carry distinct slice_index values (the
+        # DCN granule); CPU multi-process emulation reports one slice (or
+        # none) for every device — there the process IS the granule (one
+        # "slice" per host), which is also the correct grouping for the
+        # 2-process DCN test rig.
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        by_process = len(slice_ids) <= 1
         dev_array = mesh_utils.create_hybrid_device_mesh(
-            cfg.shape, dcn.shape, devices=devices
+            cfg.shape, dcn.shape, devices=devices,
+            process_is_granule=by_process,
         )
     elif devices[0].platform == "tpu" and len(devices) > 1:
         from jax.experimental import mesh_utils

@@ -11,12 +11,21 @@ rank-per-process PP runtime:
   ``jax.lax.ppermute`` (neighbouring ICI hops); the batch is split into
   microbatches so stages overlap work (classic GPipe schedule: at step t,
   stage s processes microbatch t−s; fill+drain bubble = (n−1)/(n−1+M)).
-- Embedding and the LM head run outside the pipelined region (replicated);
-  the last stage's outputs are combined with a masked ``psum`` so every
-  device returns the same logits — SPMD in, SPMD out.
+- **Partial-manual shard_map** (``axis_names={"pipe"}``): only the pipe
+  axis is manual; every other mesh axis (``data``, ``model``, ``expert``)
+  stays automatic, so the Megatron TP sharding of the per-stage weights
+  keeps working inside the stage body — XLA still inserts the per-layer
+  all-reduce over ``model``, composing PP × TP without hand-written
+  collectives.
+- Embedding and the LM head run outside the pipelined region (handled by
+  ``models/transformer.py::forward``, which dispatches its layer stack
+  here whenever the serving mesh has a >1 ``pipe`` axis); the last stage's
+  outputs are combined with a masked ``psum`` so every device returns the
+  same activations — SPMD in, SPMD out.
 
 Numerics match models/transformer.py::forward exactly (same _layer body);
-parity is tested on the 8-virtual-device CPU mesh (tests/test_pipeline.py).
+parity is tested on the 8-virtual-device CPU mesh (tests/test_pipeline.py)
+and through the serving engines (tests/test_mesh_serving.py).
 """
 
 from __future__ import annotations
@@ -95,6 +104,65 @@ def _pipe_shard(lp, h_mb, pos_mb, k, v, *, cfg: ModelConfig, axis: str,
     return outs, k, v
 
 
+def pipeline_layers(
+    layer_params,
+    cfg: ModelConfig,
+    h: jnp.ndarray,               # [B, S, D] embedded hidden states
+    positions: jnp.ndarray,       # [B, S] int32 absolute positions
+    k: jnp.ndarray,               # [L, B, S_alloc, KV, hd] cache keys
+    v: jnp.ndarray,               # [L, B, S_alloc, KV, hd] cache values
+    mesh: Mesh,
+    *,
+    axis: str = "pipe",
+    microbatches: Optional[int] = None,
+    kv_limit: int,
+    attn_impl: str = "dense",
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run the stacked layer stack pipelined over ``axis``; the embedding /
+    final-norm / LM-head stay with the caller (forward()). Returns
+    ``(h_out [B, S, D], new_k, new_v)``.
+
+    Requires n_layers divisible by the stage count. The microbatch count
+    defaults to the largest divisor of B within the stage count (B=1 —
+    e.g. a single-slot admission prefill — degrades to a sequential stage
+    relay: correct, just bubble-bound).
+
+    Only the ``pipe`` axis is manual here; ``data``/``model``/``expert``
+    shardings on the inputs flow through automatically (PP × TP works; the
+    Pallas flash/paged kernels and ring attention do NOT compose with the
+    stage body — callers pass attn_impl="dense").
+    """
+    n_stages = mesh.shape[axis]
+    B, S, D = h.shape
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} must divide pipe stages {n_stages}"
+        )
+    if microbatches is None:
+        M = max(m for m in range(1, min(n_stages, B) + 1) if B % m == 0)
+    else:
+        M = microbatches
+    if B % M:
+        raise ValueError(
+            f"microbatch count {M} must divide the batch ({B})"
+        )
+    Bm = B // M
+    h_mb = h.reshape(M, Bm, S, D)
+    pos_mb = positions.reshape(M, Bm, S)
+
+    layer_specs = jax.tree_util.tree_map(lambda _: P(axis), layer_params)
+    fn = jax.shard_map(
+        partial(_pipe_shard, cfg=cfg, axis=axis, n_stages=n_stages,
+                n_micro=M, kv_limit=kv_limit, attn_impl=attn_impl),
+        mesh=mesh,
+        in_specs=(layer_specs, P(), P(), P(axis), P(axis)),
+        out_specs=(P(), P(axis), P(axis)),
+        axis_names={axis},
+    )
+    outs, new_k, new_v = fn(layer_params, h_mb, pos_mb, k, v)
+    return outs.reshape(B, S, D), new_k, new_v
+
+
 def pipeline_forward(
     params,
     cfg: ModelConfig,
@@ -110,40 +178,22 @@ def pipeline_forward(
 ) -> Tuple[jnp.ndarray, KVCache]:
     """forward() with the layer stack pipelined over ``axis``.
 
-    Same contract as models/transformer.py::forward. Requires n_layers and
-    the batch divisible by the stage count / microbatch count.
+    Same contract as models/transformer.py::forward (which calls
+    pipeline_layers itself on a >1-pipe mesh; this wrapper remains the
+    library-level entry point and the unit-test surface).
     """
-    n_stages = mesh.shape[axis]
-    B, S = tokens.shape
-    if cfg.n_layers % n_stages:
-        raise ValueError(
-            f"n_layers {cfg.n_layers} must divide pipe stages {n_stages}"
-        )
-    M = microbatches or min(n_stages, B)
-    if B % M:
-        raise ValueError(
-            f"microbatch count {M} must divide the batch ({B})"
-        )
     if kv_limit is None:
         kv_limit = cache.max_seq
 
     h = params["embed"][tokens]
     if cfg.embed_scale:
         h = h * jnp.asarray(cfg.dim ** 0.5, h.dtype)
-    Bm = B // M
-    h_mb = h.reshape(M, Bm, S, -1)
-    pos_mb = positions.reshape(M, Bm, S)
 
-    layer_specs = jax.tree_util.tree_map(lambda _: P(axis), params["layers"])
-    fn = jax.shard_map(
-        partial(_pipe_shard, cfg=cfg, axis=axis, n_stages=n_stages,
-                n_micro=M, kv_limit=kv_limit, attn_impl=attn_impl),
-        mesh=mesh,
-        in_specs=(layer_specs, P(), P(), P(axis), P(axis)),
-        out_specs=(P(), P(axis), P(axis)),
+    h, new_k, new_v = pipeline_layers(
+        params["layers"], cfg, h, positions, cache.k, cache.v, mesh,
+        axis=axis, microbatches=microbatches, kv_limit=kv_limit,
+        attn_impl=attn_impl,
     )
-    outs, new_k, new_v = fn(params["layers"], h_mb, pos_mb, cache.k, cache.v)
-    h = outs.reshape(B, S, -1)
 
     h = rms_norm(h, params["final_norm"], cfg.rms_eps, cfg.rms_offset)
     if cfg.tie_embeddings:

@@ -67,29 +67,32 @@ def _axes_prod(mesh: Mesh, axes: tuple) -> int:
 def param_specs(cfg: ModelConfig) -> Params:
     """PartitionSpec tree matching models/transformer.py::init_params.
 
-    Leading axis of every layer param is the scanned ``n_layers`` axis —
-    never sharded (lax.scan iterates it).
+    Leading axis of every layer param is the stacked ``n_layers`` axis:
+    sharded over ``pipe`` (each pipeline stage holds L/pp contiguous
+    layers — the memory win that fits a 70B across stages). On meshes
+    without a >1 pipe axis that factor is a no-op and ``lax.scan``
+    iterates the full stack as before.
     """
     layers: Params = {
-        "attn_norm": P(),
-        "wq": P(None, None, "model"),
-        "wk": P(None, None, "model"),
-        "wv": P(None, None, "model"),
-        "wo": P(None, "model", None),
-        "mlp_norm": P(),
+        "attn_norm": P("pipe"),
+        "wq": P("pipe", None, "model"),
+        "wk": P("pipe", None, "model"),
+        "wv": P("pipe", None, "model"),
+        "wo": P("pipe", "model", None),
+        "mlp_norm": P("pipe"),
     }
     if cfg.is_moe:
         layers.update(
-            router=P(),
-            w_gate=P(None, "expert", None, "model"),
-            w_up=P(None, "expert", None, "model"),
-            w_down=P(None, "expert", "model", None),
+            router=P("pipe"),
+            w_gate=P("pipe", "expert", None, "model"),
+            w_up=P("pipe", "expert", None, "model"),
+            w_down=P("pipe", "expert", "model", None),
         )
     else:
         layers.update(
-            w_gate=P(None, None, "model"),
-            w_up=P(None, None, "model"),
-            w_down=P(None, "model", None),
+            w_gate=P("pipe", None, "model"),
+            w_up=P("pipe", None, "model"),
+            w_down=P("pipe", "model", None),
         )
     specs: Params = {
         "embed": P("model", None),
@@ -102,9 +105,10 @@ def param_specs(cfg: ModelConfig) -> Params:
 
 
 def cache_specs(cfg: ModelConfig) -> Dict[str, P]:
-    """KVCache sharding: [L, B, S, KV, hd] — batch over data, KV heads over
-    model (local decode attention per TP shard)."""
-    kv = P(None, "data", None, "model", None)
+    """KVCache sharding: [L, B, S, KV, hd] — layers over pipe (each
+    pipeline stage holds only its own layers' KV), batch over data, KV
+    heads over model (local decode attention per TP shard)."""
+    kv = P("pipe", "data", None, "model", None)
     return {"k": kv, "v": kv, "lengths": P("data")}
 
 

@@ -4,16 +4,25 @@ Measures the headline metric from BASELINE.md: aggregate decode throughput
 (tokens/sec/chip) through the REAL serving path — ``render_prompt`` (system
 prompt + query, exactly what /kubectl-command serves), prefix-KV cache
 active, continuous-batching scheduler, tokenize → jit prefill → pipelined
-jit decode chunks — plus single-stream TTFT on the same path:
+jit decode chunks — plus the north-star latency clause measured on its own
+terms (VERDICT r3 item 1):
 
-- TPU: Gemma-2B geometry (BASELINE config 2, v5e-1), random-init bf16 —
-  identical compute/memory profile to real weights; weights' values don't
-  affect throughput.
-- CPU fallback (no TPU in the environment): toy-8m geometry so the run
-  finishes quickly; the JSON line still has the same schema.
+- **Tokenizer is a real BPE** (in-repo asset, tools/train_tokenizer.py):
+  the system prompt is 58 subword tokens, not 273 byte-tokens, so the
+  prefix/suffix bucket profile and TTFT path match production token
+  lengths. ``BENCH_TOKENIZER`` overrides the asset path; set it to a real
+  Gemma/Llama tokenizer.json when one is available.
+- **Gemma-2B phase** (BASELINE config 2 geometry, v5e-1): bf16 random-init,
+  bs=64 — the headline tok/s/chip number (continuity with rounds 1–3).
+- **Gemma-7B phase** (the north-star model): int8 weight-only (bf16 ~17 GB
+  does not fit one chip's HBM), bs=8, and a **TTFT distribution over 50
+  single-stream requests** (p50/p99) plus a **device-side TTFT estimate**:
+  marginal time of back-to-back prefill+sample dispatches, which strips the
+  constant host→device round trip (the tunnel) out of the figure.
+  Skipped off-TPU (CPU hosts can't fit/compile 7B in reasonable time).
 
-Throughput is the MEDIAN of 5 measured rounds (the chip shows ~2× run-to-
-run variance; best-of is not an honest statistic — VERDICT r2 weak #5).
+Throughput is the MEDIAN of measured rounds (the chip shows ~2× run-to-run
+variance; best-of is not an honest statistic — VERDICT r2 weak #5).
 
 ``vs_baseline`` is value / 2000 tok/s/chip — the BASELINE.md north-star
 throughput target (the reference itself publishes no numbers; SURVEY.md §6).
@@ -23,6 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import statistics
 import sys
 import time
@@ -30,33 +40,182 @@ import time
 import jax
 
 NORTH_STAR_TOK_S = 2000.0
+TOKENIZER_ASSET = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "ai_agent_kubectl_tpu", "assets", "tokenizer-k8s.json",
+)
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-async def run_bench() -> dict:
-    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+def make_tokenizer(cfg):
+    """Real BPE from the in-repo asset (or BENCH_TOKENIZER override);
+    byte-level fallback only if the asset is missing."""
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer, HFTokenizer
+
+    path = os.environ.get("BENCH_TOKENIZER", TOKENIZER_ASSET)
+    if os.path.isfile(path):
+        return HFTokenizer(path, cfg.bos_id, cfg.eos_ids, cfg.pad_id), path
+    log(f"bench: tokenizer asset {path} missing; falling back to bytes")
+    return ByteTokenizer(), "byte-fallback"
+
+
+async def throughput_phase(engine, *, conc: int, max_tokens: int,
+                           rounds: int, tag: str) -> list:
     from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+
+    samples = []
+    for r in range(rounds):
+        prompts = [
+            render_prompt(f"list pods in namespace team-{tag}-{r}-{i}")
+            for i in range(conc)
+        ]
+        t0 = time.monotonic()
+        results = await asyncio.gather(*[
+            engine.generate(p, max_tokens=max_tokens, temperature=0.0)
+            for p in prompts
+        ])
+        dt = time.monotonic() - t0
+        total = sum(r_.completion_tokens for r_ in results)
+        hits = sum(r_.prefix_cache_hit for r_ in results)
+        tok_s = total / dt
+        samples.append(tok_s)
+        log(f"bench[{tag}]: {total} tok across {conc} reqs in {dt:.2f}s = "
+            f"{tok_s:.0f} tok/s ({hits}/{conc} prefix hits)")
+    return samples
+
+
+async def ttft_phase(engine, *, n: int, tag: str) -> dict:
+    """Single-stream TTFT distribution through the serving path (p50/p99
+    over n requests; first request discarded as residual warmup)."""
+    from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+
+    ttfts = []
+    for i in range(n + 1):
+        r = await engine.generate(
+            render_prompt(f"describe deployment web-{tag}-{i}"),
+            max_tokens=2, temperature=0.0,
+        )
+        assert r.prefix_cache_hit, "TTFT path must hit the prefix cache"
+        ttfts.append(r.ttft_ms)
+    ttfts = sorted(ttfts[1:])
+    p50 = statistics.median(ttfts)
+    p99 = ttfts[min(len(ttfts) - 1, int(round(0.99 * len(ttfts))) - 1)]
+    log(f"bench[{tag}]: TTFT over {len(ttfts)} reqs: "
+        f"p50={p50:.1f}ms p99={p99:.1f}ms min={ttfts[0]:.1f}ms")
+    return {"ttft_p50_ms": round(p50, 2), "ttft_p99_ms": round(p99, 2),
+            "ttft_n": len(ttfts)}
+
+
+def device_ttft_phase(engine, *, reps: int = 8) -> float:
+    """Device-side TTFT: splice + suffix prefill + first-token sample,
+    measured as the MARGINAL cost of back-to-back dispatches. One dispatch
+    pays device time + host→device round trips (tens of ms through the
+    tunnel); K chained dispatches pay K × device time + the same constant
+    overhead, so (T_K − T_1)/(K − 1) isolates the device span the serving
+    path actually occupies the chip for (VERDICT r3 item 1c)."""
+    import jax.numpy as jnp
+
+    from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+
+    ids = engine.tokenizer.encode(render_prompt("get pods -o wide"))
+
+    def once():
+        logits, cache, n_prompt, hit = engine._prefill_prompt(ids, 2)
+        tok = engine._sample_fn(
+            logits, jax.random.PRNGKey(0), jnp.asarray(0.0, jnp.float32))
+        return tok
+
+    once().block_until_ready()          # warm
+    t0 = time.monotonic()
+    once().block_until_ready()
+    t1 = time.monotonic() - t0
+    t0 = time.monotonic()
+    toks = [once() for _ in range(reps)]
+    toks[-1].block_until_ready()
+    tk = time.monotonic() - t0
+    dev_ms = max((tk - t1) / (reps - 1), 0.0) * 1000.0
+    log(f"bench: device-side TTFT ≈ {dev_ms:.1f}ms "
+        f"(1-shot {t1 * 1000:.1f}ms incl. round trips, {reps} chained)")
+    return round(dev_ms, 2)
+
+
+async def run_bench() -> dict:
+    import gc
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
     from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
     from ai_agent_kubectl_tpu.models.config import get_config
 
     platform = jax.devices()[0].platform
     n_chips = len(jax.devices())
-    if platform == "tpu":
+    on_tpu = platform == "tpu"
+
+    # ---- phase 1: the north-star model on its own terms (TPU only) ----
+    # Runs FIRST: the 7B int8 engine needs ~13 of the chip's 16 GB, so it
+    # gets the clean HBM; the 2B phase fits comfortably in what remains
+    # after teardown.
+    extra7 = None
+    if on_tpu:
+        cfg7 = get_config("gemma-7b-it")
+        tok7, _ = make_tokenizer(cfg7)
+        log("bench: starting gemma-7b-it int8 phase (north-star model)")
+        # Memory budget (v5e-1, 16 GB): int8 params ≈9.3 GB; Gemma-7B is
+        # MHA (16 KV heads × 256 head_dim = 459 KB of KV per token per
+        # slot), so sequence capacity is the lever — max_seq 256 covers
+        # the ~70-token prompt + 64 generated with margin, keeping decode
+        # KV (8×272 slots ≈ 1.0 GB) + admission scratch (≤8×272 ≈ 1.0 GB)
+        # + transients inside HBM alongside the weights.
+        eng7 = BatchedJaxEngine(
+            cfg7,
+            tokenizer=tok7,
+            dtype="bfloat16",
+            quant="int8",            # bf16 (~17 GB) exceeds one chip's HBM
+            max_seq_len=256,
+            prefill_buckets=(64, 128),
+            batch_size=8,
+            chunk_len=16,
+        )
+        t0 = time.monotonic()
+        await eng7.start()
+        log(f"bench: 7B engine ready in {time.monotonic() - t0:.1f}s")
+        assert eng7._prefix is not None
+
+        ttft7 = await ttft_phase(eng7, n=50, tag="7b")
+        ttft7["ttft_device_ms"] = device_ttft_phase(eng7)
+        s7 = await throughput_phase(
+            eng7, conc=8, max_tokens=64, rounds=3, tag="7b")
+        await eng7.stop()
+        extra7 = {
+            "model": "gemma-7b-it",
+            "dtype": "bfloat16",
+            "quant": "int8",
+            "batch_size": 8,
+            "tokens_per_sec_per_chip": round(statistics.median(s7) / n_chips, 2),
+            **ttft7,
+        }
+        del eng7
+        gc.collect()
+        jax.clear_caches()
+
+    # ---- phase 2: headline throughput (Gemma-2B geometry on TPU) ----
+    if on_tpu:
         model_name, dtype, max_tokens = "gemma-2b-it", "bfloat16", 64
         batch_size, conc, rounds = 64, 64, 5
     else:
         model_name, dtype, max_tokens = "toy-8m", "float32", 32
         batch_size, conc, rounds = 4, 4, 3
-    log(f"bench: platform={platform} chips={n_chips} model={model_name} "
-        f"bs={batch_size}")
-
     cfg = get_config(model_name)
+    tokenizer, tok_path = (make_tokenizer(cfg) if on_tpu
+                           else (ByteTokenizer(), "byte-fallback"))
+    log(f"bench: platform={platform} chips={n_chips} model={model_name} "
+        f"bs={batch_size} tokenizer={os.path.basename(str(tok_path))}")
+
     engine = BatchedJaxEngine(
         cfg,
-        tokenizer=ByteTokenizer(),
+        tokenizer=tokenizer,
         dtype=dtype,
         max_seq_len=1024,
         prefill_buckets=(64, 128, 256, 512),
@@ -72,60 +231,45 @@ async def run_bench() -> dict:
     # refuses to report numbers if the cache silently no-ops.
     assert engine._prefix is not None, \
         "prefix-KV cache must be active for the real serving path"
-    log(f"bench: prefix-KV cache ACTIVE ({engine._prefix.n} tokens resident)")
+    prefix_tokens = engine._prefix.n
+    log(f"bench: prefix-KV cache ACTIVE ({prefix_tokens} tokens resident)")
 
-    # Warm-up + single-stream TTFT on the true system-prompt path: the
-    # first iteration absorbs lazy warmup and is discarded; the reported
-    # figure is the median of the rest (same statistic as throughput).
-    ttfts = []
-    for i in range(4):
-        single = await engine.generate(
-            render_prompt(f"list pods in namespace warm-{i}"),
-            max_tokens=8, temperature=0.0,
-        )
-        assert single.prefix_cache_hit, "TTFT path must hit the prefix cache"
-        ttfts.append(single.ttft_ms)
-    ttft_ms = statistics.median(ttfts[1:])
-
-    samples = []
-    for r in range(rounds):
-        prompts = [
-            render_prompt(f"list pods in namespace team-{r}-{i}")
-            for i in range(conc)
-        ]
-        t0 = time.monotonic()
-        results = await asyncio.gather(*[
-            engine.generate(p, max_tokens=max_tokens, temperature=0.0)
-            for p in prompts
-        ])
-        dt = time.monotonic() - t0
-        total = sum(r_.completion_tokens for r_ in results)
-        hits = sum(r_.prefix_cache_hit for r_ in results)
-        tok_s = total / dt
-        samples.append(tok_s)
-        log(f"bench: {total} tok across {conc} reqs in {dt:.2f}s = "
-            f"{tok_s:.0f} tok/s ({hits}/{conc} prefix hits)")
-
+    warm = await ttft_phase(engine, n=3, tag="2b-warm")
+    samples = await throughput_phase(
+        engine, conc=conc, max_tokens=max_tokens, rounds=rounds, tag="2b")
     tok_s_chip = statistics.median(samples) / n_chips
     await engine.stop()
+
+    extra = {
+        "platform": platform,
+        "chips": n_chips,
+        "model": model_name,
+        "dtype": dtype,
+        "batch_size": batch_size,
+        "concurrency": conc,
+        "rounds": rounds,
+        "statistic": "median",
+        "prefix_cache_active": True,
+        "prefix_tokens": prefix_tokens,
+        "tokenizer": os.path.basename(str(tok_path)),
+        "single_stream_ttft_ms": warm["ttft_p50_ms"],
+    }
+
+    if extra7 is not None:
+        extra["gemma_7b"] = extra7
+        # Mirror the north-star latency clause at the top level, explicitly
+        # tagged with the model it was measured on.
+        extra["ttft_model"] = "gemma-7b-it"
+        extra["ttft_p50_ms"] = extra7["ttft_p50_ms"]
+        extra["ttft_p99_ms"] = extra7["ttft_p99_ms"]
+        extra["ttft_device_ms"] = extra7["ttft_device_ms"]
+
     return {
         "metric": "aggregate_decode_tokens_per_sec_per_chip",
         "value": round(tok_s_chip, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_s_chip / NORTH_STAR_TOK_S, 4),
-        "extra": {
-            "platform": platform,
-            "chips": n_chips,
-            "model": model_name,
-            "dtype": dtype,
-            "batch_size": batch_size,
-            "concurrency": conc,
-            "rounds": rounds,
-            "statistic": "median",
-            "prefix_cache_active": True,
-            "prefix_tokens": engine._prefix.n,
-            "single_stream_ttft_ms": round(ttft_ms, 2),
-        },
+        "extra": extra,
     }
 
 

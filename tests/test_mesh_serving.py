@@ -118,6 +118,77 @@ async def test_single_seq_engine_tp_mesh_parity():
         await eng.stop()
 
 
+def _batched_dense(mesh_shape: str, **over) -> BatchedJaxEngine:
+    kw = dict(
+        tokenizer=ByteTokenizer(),
+        dtype="float32",
+        max_seq_len=128,
+        prefill_buckets=(32, 64),
+        attn_impl="dense",
+        prefix_cache=False,
+        mesh_shape=mesh_shape,
+        batch_size=4,
+        chunk_len=4,
+    )
+    kw.update(over)
+    return BatchedJaxEngine(get_config("toy-8m"), **kw)
+
+
+async def test_batched_serving_pp_tp_mesh_greedy_parity():
+    """Pipeline-parallel serving (VERDICT r3 item 4): generate() through
+    the real engine over a pp=2,tp=2 mesh matches single-device greedy
+    output exactly; params and KV cache are layer-sharded over pipe, and
+    the serving decode program carries the stage-relay ppermute."""
+    ref = await _serve(_batched_dense(""))
+
+    eng = _batched_dense("pp=2,tp=2,dp=2")
+    await eng.start()
+    try:
+        assert dict(eng.mesh.shape) == {"data": 2, "expert": 1, "pipe": 2,
+                                        "seq": 1, "model": 2}
+        # Each pipe stage holds L/2 layers of the params and the KV cache.
+        wq = eng.params["layers"]["wq"]
+        assert wq.addressable_shards[0].data.shape[0] == wq.shape[0] // 2
+        assert (eng._cache.k.addressable_shards[0].data.shape[0]
+                == eng._cache.k.shape[0] // 2)
+
+        bucket = eng._kv_buckets[0]
+        import jax.numpy as jnp
+
+        hlo = eng._batch_chunk_fns[bucket].lower(
+            eng.params, eng._tok_d, eng._pos_d, eng._cache, eng._key_d,
+            eng._temps_d, jnp.zeros((eng.batch_size,), jnp.bool_),
+        ).compile().as_text()
+        assert "collective-permute" in hlo, \
+            "expected the pipeline stage relay in the serving HLO"
+
+        out = await asyncio.gather(*[
+            eng.generate(p, max_tokens=8, temperature=0.0) for p in PROMPTS
+        ])
+        assert [r.text for r in out] == ref
+    finally:
+        await eng.stop()
+
+
+async def test_batched_serving_paged_decode_on_mesh_parity():
+    """Mesh-sharded paged decode attention (VERDICT r3 item 5): the paged
+    pallas kernel runs shard_mapped (slots over data, heads over model)
+    inside the serving decode program, with greedy parity vs the
+    single-device dense engine."""
+    ref = await _serve(_batched_dense(""))
+
+    eng = _batched_dense("dp=2,tp=2", decode_attn="paged", kv_page_size=16)
+    await eng.start()
+    try:
+        assert eng._decode_impl == "paged"
+        out = await asyncio.gather(*[
+            eng.generate(p, max_tokens=8, temperature=0.0) for p in PROMPTS
+        ])
+        assert [r.text for r in out] == ref
+    finally:
+        await eng.stop()
+
+
 def test_mesh_shape_too_many_devices_fails_fast():
     eng = _batched("dp=16")
     with pytest.raises(ValueError, match="devices"):

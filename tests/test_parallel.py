@@ -102,7 +102,7 @@ def test_sharded_params_actually_distributed():
     sp = shard_params(params, mesh, cfg)
     wq = sp["layers"]["wq"]
     assert isinstance(wq.sharding, NamedSharding)
-    assert wq.sharding.spec == P(None, None, "model")
+    assert wq.sharding.spec == P("pipe", None, "model")  # pipe is size-1 here (no-op factor)
     # Each shard holds 1/8 of the columns.
     assert wq.addressable_shards[0].data.shape[-1] == wq.shape[-1] // 8
 
@@ -145,7 +145,7 @@ def test_single_device_mesh_runs_sharded_path():
 def test_cache_specs_shard_kv_heads():
     cfg = get_config("llama-3-8b-instruct")
     specs = cache_specs(cfg)
-    assert specs["k"] == P(None, "data", None, "model", None)
+    assert specs["k"] == P("pipe", "data", None, "model", None)
     assert specs["lengths"] == P("data")
 
 

@@ -118,3 +118,34 @@ async def test_engine_serves_with_int8_quant():
         assert r.finish_reason in ("length", "stop")
     finally:
         await eng.stop()
+
+
+def test_random_params_int8_matches_quantized_init_structure():
+    """random_params_int8 (the no-materialization bench init) must produce
+    the exact tree structure/shapes/dtypes of quantize_params_int8 over a
+    real init — serving programs then compile identically to a real int8
+    checkpoint."""
+    import jax
+
+    from ai_agent_kubectl_tpu.models.config import get_config
+    from ai_agent_kubectl_tpu.models.transformer import init_params
+    from ai_agent_kubectl_tpu.ops.quant import (
+        quantize_params_int8,
+        random_params_int8,
+    )
+
+    cfg = get_config("toy-8m")
+    key = jax.random.PRNGKey(0)
+    ref = jax.eval_shape(
+        lambda k: quantize_params_int8(init_params(k, cfg, dtype=jnp.bfloat16)),
+        key,
+    )
+    got = jax.eval_shape(
+        lambda k: random_params_int8(k, cfg, dtype=jnp.bfloat16), key
+    )
+    ref_l, ref_t = jax.tree_util.tree_flatten_with_path(ref)
+    got_l, got_t = jax.tree_util.tree_flatten_with_path(got)
+    assert ref_t == got_t
+    for (pr, r), (pg, g) in zip(ref_l, got_l):
+        assert pr == pg
+        assert r.shape == g.shape and r.dtype == g.dtype, (pr, r, g)

@@ -1,0 +1,111 @@
+"""End-to-end: real checkpoint loading + real BPE tokenizer through HTTP
+(VERDICT r3 item 3).
+
+A tiny HF Llama checkpoint is saved as safetensors and served by the REAL
+continuous-batching engine — ``convert_hf_checkpoint`` loads the weights,
+``HFTokenizer`` loads the in-repo BPE asset (tools/train_tokenizer.py) —
+and requests flow through the full aiohttp stack. This is the integration
+the per-component tests (test_convert.py logit parity, tokenizer units)
+don't cover: MODEL_PATH + TOKENIZER_PATH wiring inside the engine's own
+startup, serving real subword token lengths.
+"""
+
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai_agent_kubectl_tpu.config import ServiceConfig
+from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+from ai_agent_kubectl_tpu.engine.tokenizer import HFTokenizer
+from ai_agent_kubectl_tpu.models.config import ModelConfig
+from ai_agent_kubectl_tpu.server.app import create_app
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+TOKENIZER_ASSET = (Path(__file__).resolve().parent.parent
+                   / "ai_agent_kubectl_tpu" / "assets" / "tokenizer-k8s.json")
+
+
+def _save_tiny_llama(tmp_path, vocab_size: int):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=vocab_size, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+    )
+    torch.manual_seed(7)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+
+async def test_converted_checkpoint_and_bpe_tokenizer_through_http(tmp_path):
+    assert TOKENIZER_ASSET.is_file(), \
+        "in-repo tokenizer asset missing (run tools/train_tokenizer.py)"
+    tok_probe = HFTokenizer(TOKENIZER_ASSET, 1, (2,), 0)
+    vocab = tok_probe.vocab_size
+    _save_tiny_llama(tmp_path, vocab)
+
+    cfg = ModelConfig(
+        name="tiny-llama-http", vocab_size=vocab, dim=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=16, mlp_hidden=176,
+        rope_theta=10000.0, rms_eps=1e-5, bos_id=1, eos_ids=(2,), pad_id=0,
+        max_seq_len=2048,
+    )
+    # MODEL_PATH → convert_hf_checkpoint; TOKENIZER_PATH → HFTokenizer:
+    # both resolved inside the engine's own startup (_load), exactly the
+    # production wiring.
+    engine = BatchedJaxEngine(
+        cfg,
+        model_path=str(tmp_path),
+        tokenizer_path=str(TOKENIZER_ASSET),
+        dtype="float32",
+        max_seq_len=256,
+        prefill_buckets=(128,),
+        attn_impl="dense",
+        batch_size=2,
+        chunk_len=4,
+    )
+    svc_cfg = ServiceConfig(engine="jax", model_name="toy-8m",
+                            llm_timeout=60.0, max_new_tokens=8)
+    app = create_app(svc_cfg, engine)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        assert isinstance(engine.tokenizer, HFTokenizer)
+        # Real subword lengths: the serving prompt is ~70 BPE tokens, not
+        # the ~280 a byte-level fallback would produce.
+        n_prompt = len(engine.tokenizer.encode(render_prompt("list all pods")))
+        assert n_prompt < 120, n_prompt
+
+        # The prefix-KV cache keys on the BPE-tokenized system prompt.
+        assert engine._prefix is not None
+        assert engine._prefix.n < 80
+
+        # Random weights produce garbage text, so /kubectl-command may
+        # legitimately 422 (unsafe-output) — but the whole path must run:
+        # HTTP → sanitize → engine (converted checkpoint, BPE tokenizer)
+        # → parser.
+        resp = await client.post("/kubectl-command",
+                                 json={"query": "list all pods"})
+        assert resp.status in (200, 422), await resp.text()
+
+        # The stream endpoint reports generation as SSE either way.
+        resp = await client.post("/kubectl-command/stream",
+                                 json={"query": "show me the nodes"})
+        assert resp.status == 200
+        text = await resp.text()
+        assert "event: done" in text or "event: error" in text
+
+        # Deterministic greedy decode through the converted weights.
+        r1 = await engine.generate(render_prompt("get pods"), max_tokens=6,
+                                   temperature=0.0)
+        r2 = await engine.generate(render_prompt("get pods"), max_tokens=6,
+                                   temperature=0.0)
+        assert r1.text == r2.text
+        assert r1.prompt_tokens == len(engine.tokenizer.encode(
+            render_prompt("get pods")))
+    finally:
+        await client.close()
