@@ -12,14 +12,25 @@ terms (VERDICT r3 item 1):
   prefix/suffix bucket profile and TTFT path match production token
   lengths. ``BENCH_TOKENIZER`` overrides the asset path; set it to a real
   Gemma/Llama tokenizer.json when one is available.
+- **Gemma-7B phase** (the north-star model): int8 weight-only (bf16 ~17 GB
+  does not fit one chip's HBM), with a **TTFT distribution over 50
+  single-stream requests** (p50/p99) plus a **device-side TTFT estimate**
+  (marginal time of back-to-back prefill+sample dispatches, which strips
+  the constant host→device round trip — the tunnel — out of the figure).
+  Decode is weight-read-bound (int8 7B ≈ 8.6 GB ⇒ ~16 ms/step floor), so
+  batch size is the throughput lever: a ladder tries bs=32 @ max_seq 192
+  first and falls back (16, then 8) if the KV pool + admission scratch
+  don't fit beside the weights. Skipped off-TPU.
 - **Gemma-2B phase** (BASELINE config 2 geometry, v5e-1): bf16 random-init,
   bs=64 — the headline tok/s/chip number (continuity with rounds 1–3).
-- **Gemma-7B phase** (the north-star model): int8 weight-only (bf16 ~17 GB
-  does not fit one chip's HBM), bs=8, and a **TTFT distribution over 50
-  single-stream requests** (p50/p99) plus a **device-side TTFT estimate**:
-  marginal time of back-to-back prefill+sample dispatches, which strips the
-  constant host→device round trip (the tunnel) out of the figure.
-  Skipped off-TPU (CPU hosts can't fit/compile 7B in reasonable time).
+
+**Each phase runs in its own subprocess**: round 4 measured that after a
+7B engine is torn down in-process (del + gc + ``jax.clear_caches()``), the
+next engine's weight init still hits RESOURCE_EXHAUSTED — freed HBM isn't
+returned to the allocator promptly. Process exit is the only reliable
+release, and it also means an OOM rung of the 7B ladder can't poison the
+phases after it. The orchestrator itself never imports jax (the tunnel
+device is exclusive; a parent holding it would starve the children).
 
 Throughput is the MEDIAN of measured rounds (the chip shows ~2× run-to-run
 variance; best-of is not an honest statistic — VERDICT r2 weak #5).
@@ -30,20 +41,27 @@ throughput target (the reference itself publishes no numbers; SURVEY.md §6).
 
 from __future__ import annotations
 
+import argparse
 import asyncio
 import json
 import os
 import statistics
+import subprocess
 import sys
 import time
-
-import jax
 
 NORTH_STAR_TOK_S = 2000.0
 TOKENIZER_ASSET = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "ai_agent_kubectl_tpu", "assets", "tokenizer-k8s.json",
 )
+# (batch_size, max_seq_len) rungs for the 7B phase, tried in order. Memory
+# budget on a 16 GB v5e chip: int8 params ≈9.3 GB; Gemma-7B is MHA
+# (16 KV heads × 256 head_dim ⇒ 459 KB of KV per token per slot), so the
+# KV pool is bs × max_seq × 459 KB (32×192 ≈ 2.8 GB) and admission scratch
+# adds ≤ bs × bucket × 459 KB in transients. max_seq 192 covers the
+# ~75-token prompt + 64 generated with margin.
+LADDER_7B = ((32, 192), (16, 256), (8, 256))
 
 
 def log(msg: str) -> None:
@@ -106,7 +124,7 @@ async def ttft_phase(engine, *, n: int, tag: str) -> dict:
     log(f"bench[{tag}]: TTFT over {len(ttfts)} reqs: "
         f"p50={p50:.1f}ms p99={p99:.1f}ms min={ttfts[0]:.1f}ms")
     return {"ttft_p50_ms": round(p50, 2), "ttft_p99_ms": round(p99, 2),
-            "ttft_n": len(ttfts)}
+            "ttft_min_ms": round(ttfts[0], 2), "ttft_n": len(ttfts)}
 
 
 def device_ttft_phase(engine, *, reps: int = 8) -> float:
@@ -116,6 +134,7 @@ def device_ttft_phase(engine, *, reps: int = 8) -> float:
     tunnel); K chained dispatches pay K × device time + the same constant
     overhead, so (T_K − T_1)/(K − 1) isolates the device span the serving
     path actually occupies the chip for (VERDICT r3 item 1c)."""
+    import jax
     import jax.numpy as jnp
 
     from ai_agent_kubectl_tpu.engine.prompts import render_prompt
@@ -142,8 +161,57 @@ def device_ttft_phase(engine, *, reps: int = 8) -> float:
     return round(dev_ms, 2)
 
 
-async def run_bench() -> dict:
-    import gc
+# ---------------------------------------------------------------------------
+# Phases (each runs in its own subprocess; prints one JSON line on stdout)
+# ---------------------------------------------------------------------------
+
+async def phase_7b(batch_size: int, max_seq: int) -> dict:
+    import jax
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "not on TPU"}
+
+    cfg7 = get_config("gemma-7b-it")
+    tok7, _ = make_tokenizer(cfg7)
+    log(f"bench: starting gemma-7b-it int8 phase "
+        f"(north-star model, bs={batch_size} max_seq={max_seq})")
+    eng7 = BatchedJaxEngine(
+        cfg7,
+        tokenizer=tok7,
+        dtype="bfloat16",
+        quant="int8",            # bf16 (~17 GB) exceeds one chip's HBM
+        max_seq_len=max_seq,
+        prefill_buckets=(64, 128),
+        batch_size=batch_size,
+        chunk_len=16,
+    )
+    t0 = time.monotonic()
+    await eng7.start()
+    log(f"bench: 7B engine ready in {time.monotonic() - t0:.1f}s")
+    assert eng7._prefix is not None
+
+    ttft7 = await ttft_phase(eng7, n=50, tag="7b")
+    ttft7["ttft_device_ms"] = device_ttft_phase(eng7)
+    s7 = await throughput_phase(
+        eng7, conc=batch_size, max_tokens=64, rounds=3, tag="7b")
+    await eng7.stop()
+    return {
+        "model": "gemma-7b-it",
+        "dtype": "bfloat16",
+        "quant": "int8",
+        "batch_size": batch_size,
+        "max_seq_len": max_seq,
+        "tokens_per_sec_per_chip": round(
+            statistics.median(s7) / len(jax.devices()), 2),
+        **ttft7,
+    }
+
+
+async def phase_2b() -> dict:
+    import jax
 
     from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
     from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
@@ -153,54 +221,6 @@ async def run_bench() -> dict:
     n_chips = len(jax.devices())
     on_tpu = platform == "tpu"
 
-    # ---- phase 1: the north-star model on its own terms (TPU only) ----
-    # Runs FIRST: the 7B int8 engine needs ~13 of the chip's 16 GB, so it
-    # gets the clean HBM; the 2B phase fits comfortably in what remains
-    # after teardown.
-    extra7 = None
-    if on_tpu:
-        cfg7 = get_config("gemma-7b-it")
-        tok7, _ = make_tokenizer(cfg7)
-        log("bench: starting gemma-7b-it int8 phase (north-star model)")
-        # Memory budget (v5e-1, 16 GB): int8 params ≈9.3 GB; Gemma-7B is
-        # MHA (16 KV heads × 256 head_dim = 459 KB of KV per token per
-        # slot), so sequence capacity is the lever — max_seq 256 covers
-        # the ~70-token prompt + 64 generated with margin, keeping decode
-        # KV (8×272 slots ≈ 1.0 GB) + admission scratch (≤8×272 ≈ 1.0 GB)
-        # + transients inside HBM alongside the weights.
-        eng7 = BatchedJaxEngine(
-            cfg7,
-            tokenizer=tok7,
-            dtype="bfloat16",
-            quant="int8",            # bf16 (~17 GB) exceeds one chip's HBM
-            max_seq_len=256,
-            prefill_buckets=(64, 128),
-            batch_size=8,
-            chunk_len=16,
-        )
-        t0 = time.monotonic()
-        await eng7.start()
-        log(f"bench: 7B engine ready in {time.monotonic() - t0:.1f}s")
-        assert eng7._prefix is not None
-
-        ttft7 = await ttft_phase(eng7, n=50, tag="7b")
-        ttft7["ttft_device_ms"] = device_ttft_phase(eng7)
-        s7 = await throughput_phase(
-            eng7, conc=8, max_tokens=64, rounds=3, tag="7b")
-        await eng7.stop()
-        extra7 = {
-            "model": "gemma-7b-it",
-            "dtype": "bfloat16",
-            "quant": "int8",
-            "batch_size": 8,
-            "tokens_per_sec_per_chip": round(statistics.median(s7) / n_chips, 2),
-            **ttft7,
-        }
-        del eng7
-        gc.collect()
-        jax.clear_caches()
-
-    # ---- phase 2: headline throughput (Gemma-2B geometry on TPU) ----
     if on_tpu:
         model_name, dtype, max_tokens = "gemma-2b-it", "bfloat16", 64
         batch_size, conc, rounds = 64, 64, 5
@@ -240,7 +260,7 @@ async def run_bench() -> dict:
     tok_s_chip = statistics.median(samples) / n_chips
     await engine.stop()
 
-    extra = {
+    return {
         "platform": platform,
         "chips": n_chips,
         "model": model_name,
@@ -252,21 +272,75 @@ async def run_bench() -> dict:
         "prefix_cache_active": True,
         "prefix_tokens": prefix_tokens,
         "tokenizer": os.path.basename(str(tok_path)),
+        "tokens_per_sec_per_chip": round(tok_s_chip, 2),
         "single_stream_ttft_ms": warm["ttft_p50_ms"],
     }
 
+
+# ---------------------------------------------------------------------------
+# Orchestrator (no jax import here — the tunnel TPU is exclusive)
+# ---------------------------------------------------------------------------
+
+def _run_phase(args: list, timeout: float, script: str | None = None) -> dict | None:
+    """Run one phase subprocess; parse its final stdout line as JSON.
+
+    Also used by tools/bench_paged_gqa.py (pass ``script``) so there is one
+    hardened spawn-and-parse path: timeouts and non-JSON stdout are logged
+    failures (None), not tracebacks."""
+    cmd = [sys.executable, script or os.path.abspath(__file__)] + args
+    log(f"bench: spawn {' '.join(args)}")
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        log(f"bench: phase {args} timed out after {timeout:.0f}s")
+        return None
+    if proc.returncode != 0:
+        log(f"bench: phase {args} exited {proc.returncode}")
+        return None
+    lines = [ln for ln in proc.stdout.decode().splitlines() if ln.strip()]
+    if not lines:
+        return None
+    try:
+        return json.loads(lines[-1])
+    except json.JSONDecodeError:
+        log(f"bench: phase {args} emitted non-JSON: {lines[-1]!r}")
+        return None
+
+
+def orchestrate() -> dict:
+    # North-star model first (cleanest statement of the 7B numbers); each
+    # rung is a fresh process so an OOM can't leak into later phases.
+    extra7 = None
+    for bs, max_seq in LADDER_7B:
+        r = _run_phase(
+            ["--phase", "7b", "--bs", str(bs), "--max-seq", str(max_seq)],
+            timeout=2400)
+        if r is not None and "skipped" in r:
+            log(f"bench: 7B phase skipped ({r['skipped']})")
+            break
+        if r is not None:
+            extra7 = r
+            break
+        log(f"bench: 7B rung bs={bs} failed; trying next")
+
+    r2 = _run_phase(["--phase", "2b"], timeout=2400)
+    if r2 is None:
+        raise RuntimeError("headline (2B/toy) bench phase failed")
+
+    tok_s_chip = r2.pop("tokens_per_sec_per_chip")
+    extra = dict(r2)
     if extra7 is not None:
         extra["gemma_7b"] = extra7
         # Mirror the north-star latency clause at the top level, explicitly
         # tagged with the model it was measured on.
         extra["ttft_model"] = "gemma-7b-it"
-        extra["ttft_p50_ms"] = extra7["ttft_p50_ms"]
-        extra["ttft_p99_ms"] = extra7["ttft_p99_ms"]
-        extra["ttft_device_ms"] = extra7["ttft_device_ms"]
+        for k in ("ttft_p50_ms", "ttft_p99_ms", "ttft_device_ms"):
+            extra[k] = extra7[k]
 
     return {
         "metric": "aggregate_decode_tokens_per_sec_per_chip",
-        "value": round(tok_s_chip, 2),
+        "value": tok_s_chip,
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_s_chip / NORTH_STAR_TOK_S, 4),
         "extra": extra,
@@ -274,7 +348,18 @@ async def run_bench() -> dict:
 
 
 def main() -> None:
-    result = asyncio.run(run_bench())
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--phase", choices=["7b", "2b"], default=None)
+    ap.add_argument("--bs", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=256)
+    ns = ap.parse_args()
+
+    if ns.phase == "7b":
+        result = asyncio.run(phase_7b(ns.bs, ns.max_seq))
+    elif ns.phase == "2b":
+        result = asyncio.run(phase_2b())
+    else:
+        result = orchestrate()
     print(json.dumps(result), flush=True)
 
 

@@ -16,6 +16,11 @@ max_tokens, so per-slot live KV spans diverge — the case the paged
 kernel's per-slot page reads are built for, and the dense ladder's
 max-over-batch bucket is worst at.
 
+Each config runs in its own subprocess: freed HBM is only reliably
+returned to the allocator at process exit (bench.py round-4 finding), so
+tearing down the dense engine in-process would OOM the paged engine's
+weight init. The parent never imports jax (the tunnel device is exclusive).
+
 Usage:  python tools/bench_paged_gqa.py   (on a TPU host)
 """
 
@@ -23,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import statistics
 import sys
 import time
@@ -30,11 +36,13 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-import jax  # noqa: E402
-
 MODEL = "llama-3-8b-instruct"
 BATCH = 16
-MAX_SEQ = 1024
+# Live spans in this workload top out ≈ 420 tokens (bucket-256 prompt +
+# 160 generated); 512 halves the KV pool vs the first attempt's 1024,
+# which ran round 0 fine and then OOMed — int8-8B weights + a 2.1 GB pool
+# left no headroom for allocator churn on a 16 GB chip.
+MAX_SEQ = 512
 PAGE = 128
 ROUNDS = 3
 
@@ -44,6 +52,9 @@ def log(msg: str) -> None:
 
 
 async def serve_once(decode_attn: str) -> dict:
+    import jax
+
+    assert jax.devices()[0].platform == "tpu", "run on a TPU host"
     from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
     from ai_agent_kubectl_tpu.engine.prompts import render_prompt
     from ai_agent_kubectl_tpu.engine.tokenizer import HFTokenizer
@@ -99,13 +110,23 @@ async def serve_once(decode_attn: str) -> dict:
             "samples": [round(s, 1) for s in samples]}
 
 
-async def main() -> None:
-    assert jax.devices()[0].platform == "tpu", "run on a TPU host"
-    dense = await serve_once("dense")
-    import gc
+def run_child(decode_attn: str) -> dict:
+    from bench import _run_phase
 
-    gc.collect()
-    paged = await serve_once("paged")
+    r = _run_phase(["--impl", decode_attn], timeout=2400,
+                   script=os.path.abspath(__file__))
+    if r is None:
+        raise RuntimeError(f"{decode_attn} child failed (see stderr above)")
+    return r
+
+
+def main() -> None:
+    if "--impl" in sys.argv:
+        impl = sys.argv[sys.argv.index("--impl") + 1]
+        print(json.dumps(asyncio.run(serve_once(impl))), flush=True)
+        return
+    dense = run_child("dense")
+    paged = run_child("paged")
     out = {
         "model": MODEL, "batch": BATCH, "max_seq": MAX_SEQ,
         "kv_page_size": PAGE, "quant": "int8",
@@ -117,4 +138,4 @@ async def main() -> None:
 
 
 if __name__ == "__main__":
-    asyncio.run(main())
+    main()

@@ -29,14 +29,13 @@ Usage:  python tools/train_tokenizer.py [out_path]
 from __future__ import annotations
 
 import sys
+import zlib
 from pathlib import Path
 
-SYSTEM_PROMPT_IMPORT = True
 try:
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     from ai_agent_kubectl_tpu.engine.prompts import SYSTEM_PROMPT
 except Exception:  # pragma: no cover
-    SYSTEM_PROMPT_IMPORT = False
     SYSTEM_PROMPT = ""
 
 VOCAB_SIZE = 4096
@@ -130,8 +129,6 @@ def build_corpus() -> list:
         for n in NAMES:
             # zlib.crc32, not hash(): PYTHONHASHSEED would make the corpus
             # (and therefore the committed asset) nondeterministic.
-            import zlib
-
             pick = zlib.crc32((r + n).encode()) % len(NAMES)
             lines.append(f"kubectl describe {r} {n} -n {NAMES[pick]}")
     lines.extend(ENGLISH.strip().splitlines() * 4)
