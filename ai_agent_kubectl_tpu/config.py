@@ -133,6 +133,12 @@ class ServiceConfig:
     # weight bytes — decode is weight-read-bound, so near-proportional
     # throughput for large dense models. "" disables.
     quant: str = ""                         # QUANT: "" | int8
+    # int8 KV cache (ops/quant.py::QuantKV): halves the KV pool and the
+    # per-step decode-attention HBM read — on HBM-capped single-chip
+    # serving (7B-class) this doubles the decode batch that fits beside
+    # the weights. Single-device only (disabled with a warning under a
+    # mesh); DECODE_ATTN=paged falls back to the dense ladder.
+    kv_quant: str = ""                      # KV_QUANT: "" | int8
     max_seq_len: int = 1024                 # MAX_SEQ_LEN
     max_new_tokens: int = 128               # MAX_NEW_TOKENS
     decode_batch_size: int = 8              # DECODE_BATCH_SIZE (continuous batching slots)
@@ -206,6 +212,7 @@ class ServiceConfig:
             tokenizer_path=_env_str("TOKENIZER_PATH", None),
             dtype=_env_str("DTYPE", "bfloat16"),
             quant=(_env_str("QUANT", "") or "").lower(),
+            kv_quant=(_env_str("KV_QUANT", "") or "").lower(),
             max_seq_len=_env_int("MAX_SEQ_LEN", 1024),
             max_new_tokens=_env_int("MAX_NEW_TOKENS", 128),
             decode_batch_size=_env_int("DECODE_BATCH_SIZE", 8),

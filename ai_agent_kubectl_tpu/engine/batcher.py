@@ -45,6 +45,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import KVCache, forward
+from ..ops.quant import (kv_broadcast_rows, kv_set_slots, kv_slot_update,
+                         kv_tokens, kv_update_slice)
 from .jax_engine import JaxEngine
 from .protocol import EngineResult, EngineUnavailable, GenerationTimeout
 from .sampling import sample_tokens_batched
@@ -126,6 +128,7 @@ class BatchedJaxEngine(JaxEngine):
             tokenizer_path=cfg.tokenizer_path,
             dtype=cfg.dtype,
             quant=cfg.quant,
+            kv_quant=cfg.kv_quant,
             max_seq_len=cfg.max_seq_len,
             prefill_buckets=cfg.prefill_bucket_list,
             attn_impl=cfg.attn_impl,
@@ -170,6 +173,12 @@ class BatchedJaxEngine(JaxEngine):
         # call is shard_mapped in models/transformer.py); only the pipe
         # axis forces dense.
         decode_impl = "dense" if self.decode_attn == "auto" else self.decode_attn
+        if decode_impl == "paged" and self.kv_quant:
+            # The pallas paged kernel reads bf16 KV; the dense ladder's
+            # dequant fuses into its attention matmuls.
+            logger.warning("DECODE_ATTN=paged does not read int8 KV; "
+                           "falling back to the dense KV ladder")
+            decode_impl = "dense"
         if (decode_impl == "paged" and self.mesh is not None
                 and self.mesh.shape["pipe"] > 1):
             # The pipelined layer path always runs dense attention (the
@@ -246,8 +255,8 @@ class BatchedJaxEngine(JaxEngine):
             ``first_tok`` is a [1] device array — admission never reads it
             back to the host; the token value travels to the client via the
             inflight pipeline."""
-            k = jax.lax.dynamic_update_slice(cache.k, src_k, (0, slot, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(cache.v, src_v, (0, slot, 0, 0, 0))
+            k = kv_slot_update(cache.k, src_k, slot)
+            v = kv_slot_update(cache.v, src_v, slot)
             lengths = cache.lengths.at[slot].set(n_prompt)
             tok = tok.at[slot, 0].set(first_tok[0])
             pos = pos.at[slot, 0].set(n_prompt)
@@ -610,13 +619,9 @@ class BatchedJaxEngine(JaxEngine):
         fn = self._batch_admit_fns.get(key)
         if fn is None:
             def splice_prefix_batch(cache, pk, pv):
-                L, _, P = pk.shape[:3]
-                shape = (L, kpad, P) + pk.shape[3:]
-                k = jax.lax.dynamic_update_slice(
-                    cache.k, jnp.broadcast_to(pk, shape), (0, 0, 0, 0, 0))
-                v = jax.lax.dynamic_update_slice(
-                    cache.v, jnp.broadcast_to(pv, shape), (0, 0, 0, 0, 0))
-                lengths = jnp.full_like(cache.lengths, P)
+                k = kv_update_slice(cache.k, kv_broadcast_rows(pk, kpad))
+                v = kv_update_slice(cache.v, kv_broadcast_rows(pv, kpad))
+                lengths = jnp.full_like(cache.lengths, kv_tokens(pk))
                 return KVCache(k=k, v=v, lengths=lengths)
 
             fn = jax.jit(splice_prefix_batch, donate_argnums=(0,))
@@ -659,8 +664,8 @@ class BatchedJaxEngine(JaxEngine):
         if fn is None:
             def splice_many(cache, src_k, src_v, tok, pos, temps,
                             slots, n_prompts, first_toks, temperatures):
-                k = cache.k.at[:, slots].set(src_k, mode="drop")
-                v = cache.v.at[:, slots].set(src_v, mode="drop")
+                k = kv_set_slots(cache.k, src_k, slots)
+                v = kv_set_slots(cache.v, src_v, slots)
                 lengths = cache.lengths.at[slots].set(n_prompts, mode="drop")
                 tok = tok.at[slots, 0].set(first_toks, mode="drop")
                 pos = pos.at[slots, 0].set(n_prompts, mode="drop")

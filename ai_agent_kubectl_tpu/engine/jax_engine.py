@@ -81,6 +81,7 @@ class JaxEngine:
         tokenizer_path: Optional[str] = None,
         dtype: str = "bfloat16",
         quant: str = "",
+        kv_quant: str = "",
         max_seq_len: int = 1024,
         prefill_buckets: tuple = (64, 128, 256, 512, 1024),
         attn_impl: str = "auto",
@@ -97,6 +98,10 @@ class JaxEngine:
         if quant not in ("", "int8"):
             raise ValueError(f"QUANT must be '' or 'int8', got {quant!r}")
         self.quant = quant
+        if kv_quant not in ("", "int8"):
+            raise ValueError(
+                f"KV_QUANT must be '' or 'int8', got {kv_quant!r}")
+        self.kv_quant = kv_quant
         self.max_seq_len = min(max_seq_len, model_cfg.max_seq_len)
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= self.max_seq_len
@@ -157,6 +162,7 @@ class JaxEngine:
             tokenizer_path=cfg.tokenizer_path,
             dtype=cfg.dtype,
             quant=cfg.quant,
+            kv_quant=cfg.kv_quant,
             max_seq_len=cfg.max_seq_len,
             prefill_buckets=cfg.prefill_bucket_list,
             attn_impl=cfg.attn_impl,
@@ -288,7 +294,7 @@ class JaxEngine:
         """Fresh KV cache, placed per the mesh policy when sharded serving
         is on (batch over ``data``, KV heads over ``model``)."""
         cache = KVCache.zeros(self.model_cfg, batch, max_seq or self.max_seq_len,
-                              dtype=self.dtype)
+                              dtype=self.dtype, kv_quant=self.kv_quant)
         if self.mesh is not None:
             from ..parallel.sharding import shard_cache
 
@@ -298,6 +304,26 @@ class JaxEngine:
     def _load(self) -> None:
         """Tokenizer + weights (checkpoint or random init). Shared by the
         single-sequence and batched engines."""
+        if self.kv_quant and self.mesh is not None:
+            # The sharding policy (parallel/sharding.py) and the pipeline /
+            # paged paths place plain [L,B,S,KV,hd] arrays; the QuantKV
+            # scale leaves don't have specs yet. Single-chip is where KV
+            # bytes cap batch size anyway (a mesh multiplies HBM).
+            logger.warning("KV_QUANT=int8 is single-device only for now; "
+                           "using %s KV under the mesh", self.dtype.__name__)
+            self.kv_quant = ""
+        if self.kv_quant and self.attn_impl == "flash":
+            # flash_attention_cached is a pallas_call: its operands must be
+            # materialized arrays, so an int8 context would be dequantized
+            # into a full [B, kv_limit, KV, hd] bf16 copy per layer per
+            # prefill chunk — exactly the HBM transient int8 KV exists to
+            # avoid. XLA dense attention fuses the convert+scale into the
+            # score matmul's operand read instead, and at the short
+            # single-chip buckets int8-KV serving uses, dense prefill is
+            # not the bottleneck.
+            logger.info("KV_QUANT=int8: prefill attention uses dense "
+                        "(fusable dequant) instead of flash")
+            self.attn_impl = "dense"
         if self.tokenizer is None:
             self.tokenizer = load_tokenizer(self.model_cfg, self.tokenizer_path)
         if self.params is None:
@@ -438,14 +464,17 @@ class JaxEngine:
             # round-2 "silent no-op" case, now served.
             _, cache, _ = self._prefill_chunked(list(ids))
         # Trim to the true prefix length: the padding slots' garbage K/V is
-        # never copied into request caches.
-        self._prefix = PrefixKV(ids=list(ids), k=cache.k[:, :, :P],
-                                v=cache.v[:, :, :P])
+        # never copied into request caches. (tree-mapped helpers: the K/V
+        # blocks are plain arrays or QuantKV, ops/quant.py.)
+        from ..ops.quant import kv_prefix_trim, kv_tokens, kv_update_slice
+
+        self._prefix = PrefixKV(ids=list(ids), k=kv_prefix_trim(cache.k, P),
+                                v=kv_prefix_trim(cache.v, P))
 
         def splice_prefix(cache, pk, pv):
-            k = jax.lax.dynamic_update_slice(cache.k, pk, (0, 0, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(cache.v, pv, (0, 0, 0, 0, 0))
-            lengths = jnp.full_like(cache.lengths, pk.shape[2])
+            k = kv_update_slice(cache.k, pk)
+            v = kv_update_slice(cache.v, pv)
+            lengths = jnp.full_like(cache.lengths, kv_tokens(pk))
             return KVCache(k=k, v=v, lengths=lengths)
 
         self._splice_prefix_fn = jax.jit(splice_prefix, donate_argnums=(0,))

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import dense_attention
 from ..ops.norms import rms_norm
-from ..ops.quant import qmatmul
+from ..ops.quant import QuantKV, kv_dequantize, kv_quantize, qmatmul
 from ..ops.rope import apply_rope
 from .config import ModelConfig
 
@@ -46,18 +46,27 @@ Params = Dict[str, Any]
 class KVCache:
     """Contiguous per-slot KV cache.
 
-    k, v:    [n_layers, batch, max_seq, n_kv_heads, head_dim]
+    k, v:    [n_layers, batch, max_seq, n_kv_heads, head_dim] — either the
+             model dtype, or ``QuantKV`` (int8 payload + per-(position,
+             head) f32 scales) when built with ``kv_quant="int8"``
     lengths: [batch] — number of valid positions per slot
     """
 
-    k: jnp.ndarray
-    v: jnp.ndarray
+    k: Any
+    v: Any
     lengths: jnp.ndarray
 
     @classmethod
     def zeros(cls, cfg: ModelConfig, batch: int, max_seq: int,
-              dtype=jnp.bfloat16) -> "KVCache":
+              dtype=jnp.bfloat16, kv_quant: str = "") -> "KVCache":
         shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        if kv_quant == "int8":
+            def zq():
+                return QuantKV(q=jnp.zeros(shape, jnp.int8),
+                               s=jnp.ones(shape[:-1], jnp.float32))
+
+            return cls(k=zq(), v=zq(),
+                       lengths=jnp.zeros((batch,), dtype=jnp.int32))
         return cls(
             k=jnp.zeros(shape, dtype=dtype),
             v=jnp.zeros(shape, dtype=dtype),
@@ -66,7 +75,8 @@ class KVCache:
 
     @property
     def max_seq(self) -> int:
-        return self.k.shape[2]
+        leaf = self.k.q if isinstance(self.k, QuantKV) else self.k
+        return leaf.shape[2]
 
 
 # ----------------------------------------------------------------- init
@@ -173,11 +183,30 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
 
     # Write this chunk's K/V into the cache at its absolute positions.
     # (scatter; positions are per-slot absolute indices)
-    layer_k = layer_k.at[batch_idx, positions].set(k.astype(layer_k.dtype))
-    layer_v = layer_v.at[batch_idx, positions].set(v.astype(layer_v.dtype))
-
-    k_ctx = layer_k[:, :kv_limit]
-    v_ctx = layer_v[:, :kv_limit]
+    if isinstance(layer_k, QuantKV):
+        # int8 KV: quantize the fresh chunk at write, dequantize the read
+        # span — the convert+scale is elementwise and fuses into the
+        # attention matmuls' operand reads, so only int8 bytes cross HBM
+        # for the context (half the decode-attention traffic, half the
+        # pool). The fresh chunk's own k/v stay bf16 for the ring path.
+        qk, qv = kv_quantize(k), kv_quantize(v)
+        layer_k = QuantKV(q=layer_k.q.at[batch_idx, positions].set(qk.q),
+                          s=layer_k.s.at[batch_idx, positions].set(qk.s))
+        layer_v = QuantKV(q=layer_v.q.at[batch_idx, positions].set(qv.q),
+                          s=layer_v.s.at[batch_idx, positions].set(qv.s))
+        if attn_impl == "paged" and S == 1:
+            raise NotImplementedError(
+                "paged decode attention does not read int8 KV; the engine "
+                "resolves KV_QUANT=int8 to the dense KV ladder")
+        k_ctx = kv_dequantize(
+            QuantKV(layer_k.q[:, :kv_limit], layer_k.s[:, :kv_limit]), h.dtype)
+        v_ctx = kv_dequantize(
+            QuantKV(layer_v.q[:, :kv_limit], layer_v.s[:, :kv_limit]), h.dtype)
+    else:
+        layer_k = layer_k.at[batch_idx, positions].set(k.astype(layer_k.dtype))
+        layer_v = layer_v.at[batch_idx, positions].set(v.astype(layer_v.dtype))
+        k_ctx = layer_k[:, :kv_limit]
+        v_ctx = layer_v[:, :kv_limit]
     # Causal mask over absolute positions (padding queries read garbage but
     # their outputs are never used).
     kv_pos = jnp.arange(kv_limit)[None, None, :]
@@ -310,6 +339,10 @@ def forward(
         # warns at mesh setup when pp>1 meets an expert axis).
         from ..parallel.pipeline import pipeline_layers
 
+        if isinstance(cache.k, QuantKV):
+            raise NotImplementedError(
+                "pipeline-parallel serving does not read int8 KV; the "
+                "engine disables KV_QUANT under a mesh")
         h, new_k, new_v = pipeline_layers(
             params["layers"], cfg, h, positions, cache.k, cache.v, mesh,
             kv_limit=kv_limit, attn_impl="dense",

@@ -84,6 +84,95 @@ def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
+# --------------------------------------------------------------- KV cache
+#
+# int8 KV cache (KV_QUANT=int8): decode attention reads the whole live KV
+# span every step, and on HBM-bound 7B-class single-chip serving the KV
+# pool is what caps the decode batch size (round 4: Gemma-7B int8 weights
+# + a bf16 KV pool fit bs=16; the bs=32 rung OOMed). Halving KV bytes
+# halves both the pool (→ 2× the slots in the same HBM) and the per-step
+# attention read. Per-(token, head) symmetric scales over the head_dim
+# axis — the finest granularity that adds only 1/head_dim of overhead
+# (f32 scale per 256 int8 payload bytes ≈ 1.6%).
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QuantKV:
+    """Symmetric int8 KV block with per-(…, head) scales.
+
+    q: int8, the original KV shape  [..., n_kv_heads, head_dim]
+    s: f32,  one scale per head vector  [..., n_kv_heads]
+
+    A registered pytree: ``jax.tree.map`` recurses into (q, s), so cache
+    splice/slice/scatter code written as tree.maps works identically for
+    plain bf16 arrays and QuantKV (the scale leaf just has one fewer
+    trailing axis — all structural ops below index leading axes only).
+    """
+
+    q: jnp.ndarray
+    s: jnp.ndarray
+
+
+def kv_quantize(x: jnp.ndarray) -> QuantKV:
+    """[..., hd] bf16 → int8 with one f32 scale per trailing vector."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    s = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return QuantKV(q=q, s=s)
+
+
+def kv_dequantize(kv: QuantKV, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Elementwise convert+scale; inside a jitted attention this fuses into
+    the score matmul's operand read (same pattern as qmatmul's weight
+    convert, HLO-verified in tests/test_tpu_kernels.py)."""
+    return (kv.q.astype(jnp.float32) * kv.s[..., None]).astype(dtype)
+
+
+def kv_tokens(kv) -> int:
+    """Static length of the sequence axis (2) of a KV block
+    ([n_layers, batch, seq, ...]); works for plain arrays and QuantKV."""
+    leaf = kv.q if isinstance(kv, QuantKV) else kv
+    return leaf.shape[2]
+
+
+def kv_update_slice(dst, src):
+    """dynamic_update_slice of a KV block at the origin, per leaf."""
+    return jax.tree.map(
+        lambda d, s: jax.lax.dynamic_update_slice(d, s, (0,) * d.ndim),
+        dst, src)
+
+
+def kv_slot_update(dst, src, slot):
+    """Write a single-slot KV block ``src`` into slot ``slot`` (axis 1)."""
+    zero = jnp.asarray(0, jnp.int32)
+
+    def upd(d, s):
+        idx = (zero, jnp.asarray(slot, jnp.int32)) + (zero,) * (d.ndim - 2)
+        return jax.lax.dynamic_update_slice(d, s, idx)
+
+    return jax.tree.map(upd, dst, src)
+
+
+def kv_set_slots(dst, src, slots):
+    """Scatter per-row KV blocks into slots (axis 1); out-of-bounds rows
+    drop (the batched-admission padding contract)."""
+    return jax.tree.map(
+        lambda d, s: d.at[:, slots].set(s, mode="drop"), dst, src)
+
+
+def kv_broadcast_rows(src, n: int):
+    """[L, 1, P, ...] → [L, n, P, ...] per leaf (prefix → batch splice)."""
+    return jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (a.shape[0], n) + a.shape[2:]), src)
+
+
+def kv_prefix_trim(kv, p: int):
+    """Trim a KV block to its first ``p`` sequence positions."""
+    return jax.tree.map(lambda a: a[:, :, :p], kv)
+
+
 #: projection weights eligible for quantization (matmul RHS with the
 #: output channel last). Embeddings/norms/router excluded.
 _QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")

@@ -55,13 +55,15 @@ TOKENIZER_ASSET = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "ai_agent_kubectl_tpu", "assets", "tokenizer-k8s.json",
 )
-# (batch_size, max_seq_len) rungs for the 7B phase, tried in order. Memory
-# budget on a 16 GB v5e chip: int8 params ≈9.3 GB; Gemma-7B is MHA
-# (16 KV heads × 256 head_dim ⇒ 459 KB of KV per token per slot), so the
-# KV pool is bs × max_seq × 459 KB (32×192 ≈ 2.8 GB) and admission scratch
-# adds ≤ bs × bucket × 459 KB in transients. max_seq 192 covers the
+# (batch_size, max_seq_len, kv_quant) rungs for the 7B phase, tried in
+# order. Memory budget on a 16 GB v5e chip: int8 params ≈9.3 GB; Gemma-7B
+# is MHA (16 KV heads × 256 head_dim ⇒ 459 KB of KV per token per slot
+# bf16, 232 KB int8 — KV_QUANT=int8 is what lets bs>16 fit beside the
+# weights; the bf16 bs=32 rung OOMed in round 4), and admission scratch
+# adds ≤ bs × bucket × (KV bytes) in transients. max_seq 192 covers the
 # ~75-token prompt + 64 generated with margin.
-LADDER_7B = ((32, 192), (16, 256), (8, 256))
+LADDER_7B = ((64, 192, "int8"), (48, 192, "int8"), (32, 192, "int8"),
+             (16, 256, ""), (8, 256, ""))
 
 
 def log(msg: str) -> None:
@@ -165,7 +167,7 @@ def device_ttft_phase(engine, *, reps: int = 8) -> float:
 # Phases (each runs in its own subprocess; prints one JSON line on stdout)
 # ---------------------------------------------------------------------------
 
-async def phase_7b(batch_size: int, max_seq: int) -> dict:
+async def phase_7b(batch_size: int, max_seq: int, kv_quant: str) -> dict:
     import jax
 
     from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
@@ -176,13 +178,14 @@ async def phase_7b(batch_size: int, max_seq: int) -> dict:
 
     cfg7 = get_config("gemma-7b-it")
     tok7, _ = make_tokenizer(cfg7)
-    log(f"bench: starting gemma-7b-it int8 phase "
-        f"(north-star model, bs={batch_size} max_seq={max_seq})")
+    log(f"bench: starting gemma-7b-it int8 phase (north-star model, "
+        f"bs={batch_size} max_seq={max_seq} kv_quant={kv_quant or 'bf16'})")
     eng7 = BatchedJaxEngine(
         cfg7,
         tokenizer=tok7,
         dtype="bfloat16",
         quant="int8",            # bf16 (~17 GB) exceeds one chip's HBM
+        kv_quant=kv_quant,
         max_seq_len=max_seq,
         prefill_buckets=(64, 128),
         batch_size=batch_size,
@@ -202,6 +205,7 @@ async def phase_7b(batch_size: int, max_seq: int) -> dict:
         "model": "gemma-7b-it",
         "dtype": "bfloat16",
         "quant": "int8",
+        "kv_quant": kv_quant,
         "batch_size": batch_size,
         "max_seq_len": max_seq,
         "tokens_per_sec_per_chip": round(
@@ -312,9 +316,10 @@ def orchestrate() -> dict:
     # North-star model first (cleanest statement of the 7B numbers); each
     # rung is a fresh process so an OOM can't leak into later phases.
     extra7 = None
-    for bs, max_seq in LADDER_7B:
+    for bs, max_seq, kvq in LADDER_7B:
         r = _run_phase(
-            ["--phase", "7b", "--bs", str(bs), "--max-seq", str(max_seq)],
+            ["--phase", "7b", "--bs", str(bs), "--max-seq", str(max_seq),
+             "--kv-quant", kvq],
             timeout=2400)
         if r is not None and "skipped" in r:
             log(f"bench: 7B phase skipped ({r['skipped']})")
@@ -352,10 +357,11 @@ def main() -> None:
     ap.add_argument("--phase", choices=["7b", "2b"], default=None)
     ap.add_argument("--bs", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=256)
+    ap.add_argument("--kv-quant", default="")
     ns = ap.parse_args()
 
     if ns.phase == "7b":
-        result = asyncio.run(phase_7b(ns.bs, ns.max_seq))
+        result = asyncio.run(phase_7b(ns.bs, ns.max_seq, ns.kv_quant))
     elif ns.phase == "2b":
         result = asyncio.run(phase_2b())
     else:
