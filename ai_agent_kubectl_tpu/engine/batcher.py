@@ -117,6 +117,8 @@ class BatchedJaxEngine(JaxEngine):
         self._running = False
         self._group_admitted = 0   # batched group admissions served
         self._last_progress = time.monotonic()
+        self._last_admit_t = 0.0   # burst-ramp momentum (see _worker_loop)
+        self._ramp_hold_t0 = None  # when the current ramp hold engaged
 
     @classmethod
     def from_config(cls, cfg) -> "BatchedJaxEngine":
@@ -485,6 +487,32 @@ class BatchedJaxEngine(JaxEngine):
                     self._consume_oldest()
                     continue
                 if n_active > 0 and chunks_in_pipe < 2:
+                    # Burst ramp: slots a chunk is dispatched without can't
+                    # join it — a request that misses the first two
+                    # (speculative, ~0.5 s each on 7B geometry) chunks
+                    # starts >1 s late even though the whole burst arrived
+                    # within ~65 ms (round-4 probe). While admissions still
+                    # show momentum (one landed within the last 30 ms) and
+                    # free slots remain, nap briefly instead of dispatching
+                    # chunk 1, so the rest of the burst boards it. Costs a
+                    # lone request ≤ ~30 ms on its *second* token (TTFT
+                    # rides the admission program, unaffected).
+                    now = time.monotonic()
+                    if (chunks_in_pipe == 0
+                            and any(s is None for s in self._slots)
+                            and now - self._last_admit_t
+                                < self.ADMIT_RAMP_SECS):
+                        # Every admission re-arms the momentum check, so a
+                        # steady trickle could defer chunk 0 indefinitely;
+                        # the hold is additionally capped from when it
+                        # first engaged (ADMIT_RAMP_MAX_SECS).
+                        if self._ramp_hold_t0 is None:
+                            self._ramp_hold_t0 = now
+                        if now - self._ramp_hold_t0 < self.ADMIT_RAMP_MAX_SECS:
+                            if self._admissions.empty():
+                                time.sleep(0.002)
+                            continue
+                    self._ramp_hold_t0 = None
                     self._dispatch_chunk()
                     continue
                 self._prune_dead_chunks()
@@ -522,6 +550,12 @@ class BatchedJaxEngine(JaxEngine):
     #: batched-admission group sizes (pow2-padded); cap bounds the scratch
     #: KV memory (kpad × S_alloc slots) and the compile variety.
     ADMIT_KPADS = (2, 4, 8, 16)
+
+    #: how long after an admission the scheduler keeps holding the FIRST
+    #: speculative decode chunk for more of the burst to board it, and the
+    #: hard cap on one continuous hold (re-armed momentum can't exceed it).
+    ADMIT_RAMP_SECS = 0.03
+    ADMIT_RAMP_MAX_SECS = 0.12
 
     @property
     def admit_kpads(self) -> tuple:
@@ -766,6 +800,7 @@ class BatchedJaxEngine(JaxEngine):
         self._to_host_async(first_toks_d)
         self._inflight.append(("firsts", first_toks_d, pairs))
         self._group_admitted += 1
+        self._last_admit_t = time.monotonic()
 
     def _admit_one(self, req: _Request) -> None:
         """Dispatch-only admission: prefill → device-side first-token
@@ -816,6 +851,7 @@ class BatchedJaxEngine(JaxEngine):
         # (~100 ms serialized); on local PCIe it simply overlaps DMA.
         self._to_host_async(first_tok_d)
         self._inflight.append(("first", first_tok_d, req, slot_idx))
+        self._last_admit_t = time.monotonic()
 
     def _consume_first(self, first_tok: int, req: _Request,
                        slot_idx: int) -> None:

@@ -301,6 +301,14 @@ class JaxEngine:
             cache = shard_cache(cache, self.mesh, self.model_cfg)
         return cache
 
+    @property
+    def _quantize_embed(self) -> bool:
+        """int8 embedding (per-row scales) rides with QUANT=int8, single-
+        device only — shard_params has no spec for the per-row scale leaf.
+        On tied-embedding models (Gemma) this halves the LM head's
+        per-step weight read; on all models it halves embedding HBM."""
+        return self.quant == "int8" and self.mesh is None
+
     def _load(self) -> None:
         """Tokenizer + weights (checkpoint or random init). Shared by the
         single-sequence and batched engines."""
@@ -350,6 +358,7 @@ class JaxEngine:
                     self.params = random_params_int8(
                         jax.random.PRNGKey(self.seed), self.model_cfg,
                         dtype=self.dtype,
+                        quantize_embed=self._quantize_embed,
                     )
                     self._quantized = True
                 else:
@@ -360,10 +369,13 @@ class JaxEngine:
         if self.quant == "int8" and not getattr(self, "_quantized", False):
             from ..ops.quant import quantize_params_int8
 
-            self.params = quantize_params_int8(self.params)
+            self.params = quantize_params_int8(
+                self.params, quantize_embed=self._quantize_embed)
             self._quantized = True
-            logger.info("Weights quantized to int8 (weight-only, "
-                        "per-channel scales)")
+            logger.info(
+                "Weights quantized to int8 (weight-only, per-channel "
+                "scales%s)",
+                "; embedding per-row" if self._quantize_embed else "")
         if self.mesh is not None:
             from ..parallel.sharding import shard_params
 

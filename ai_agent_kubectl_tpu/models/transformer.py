@@ -34,7 +34,8 @@ import jax.numpy as jnp
 
 from ..ops.attention import dense_attention
 from ..ops.norms import rms_norm
-from ..ops.quant import QuantKV, kv_dequantize, kv_quantize, qmatmul
+from ..ops.quant import (QuantKV, embed_lookup, kv_dequantize, kv_quantize,
+                         qmatmul, tied_head)
 from ..ops.rope import apply_rope
 from .config import ModelConfig
 
@@ -323,7 +324,10 @@ def forward(
     B, S = tokens.shape
     batch_idx = jnp.arange(B)[:, None]
 
-    h = params["embed"][tokens]
+    # final_norm is always a plain array in the model dtype — it anchors
+    # the activation dtype when the embedding is stored int8.
+    h = embed_lookup(params["embed"], tokens,
+                     dtype=params["final_norm"].dtype)
     if cfg.embed_scale:
         h = h * jnp.asarray(cfg.dim ** 0.5, h.dtype)
 
@@ -364,7 +368,7 @@ def forward(
     if logits_at is not None:
         h = h[jnp.arange(B), logits_at][:, None]       # [B, 1, D]
     if cfg.tie_embeddings:
-        logits = h @ params["embed"].astype(h.dtype).T
+        logits = tied_head(h, params["embed"])
     else:
         logits = qmatmul(h, params["lm_head"])
 

@@ -62,7 +62,10 @@ TOKENIZER_ASSET = os.path.join(
 # weights; the bf16 bs=32 rung OOMed in round 4), and admission scratch
 # adds ≤ bs × bucket × (KV bytes) in transients. max_seq 192 covers the
 # ~75-token prompt + 64 generated with margin.
-LADDER_7B = ((64, 192, "int8"), (48, 192, "int8"), (32, 192, "int8"),
+# bs=64 was tried and is out of reach on this 16 GB chip: the decode
+# program's compile fails (remote-compile helper exit 1) or the admission
+# warm OOMs even with int8 KV + int8 embedding. 48 is the proven top rung.
+LADDER_7B = ((48, 192, "int8"), (32, 192, "int8"),
              (16, 256, ""), (8, 256, ""))
 
 
@@ -150,16 +153,25 @@ def device_ttft_phase(engine, *, reps: int = 8) -> float:
         return tok
 
     once().block_until_ready()          # warm
-    t0 = time.monotonic()
-    once().block_until_ready()
-    t1 = time.monotonic() - t0
-    t0 = time.monotonic()
-    toks = [once() for _ in range(reps)]
-    toks[-1].block_until_ready()
-    tk = time.monotonic() - t0
-    dev_ms = max((tk - t1) / (reps - 1), 0.0) * 1000.0
+    # Tunnel RTTs are noisy (p99 ≈ 2 s observed); one (1-shot, chained)
+    # pair can even come out negative-marginal. Take the best of several
+    # trials — the marginal estimate is an upper-bound-noise measurement,
+    # so min is the honest statistic for "device span".
+    trials = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        once().block_until_ready()
+        t1 = time.monotonic() - t0
+        t0 = time.monotonic()
+        toks = [once() for _ in range(reps)]
+        toks[-1].block_until_ready()
+        tk = time.monotonic() - t0
+        trials.append((max((tk - t1) / (reps - 1), 0.0) * 1000.0,
+                       t1 * 1000.0))
+    dev_ms, one_shot = min(trials)
     log(f"bench: device-side TTFT ≈ {dev_ms:.1f}ms "
-        f"(1-shot {t1 * 1000:.1f}ms incl. round trips, {reps} chained)")
+        f"(best of {len(trials)}; 1-shot {one_shot:.1f}ms incl. round "
+        f"trips, {reps} chained)")
     return round(dev_ms, 2)
 
 
@@ -167,7 +179,8 @@ def device_ttft_phase(engine, *, reps: int = 8) -> float:
 # Phases (each runs in its own subprocess; prints one JSON line on stdout)
 # ---------------------------------------------------------------------------
 
-async def phase_7b(batch_size: int, max_seq: int, kv_quant: str) -> dict:
+async def phase_7b(batch_size: int, max_seq: int, kv_quant: str,
+                   chunk_len: int = 16) -> dict:
     import jax
 
     from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
@@ -189,7 +202,7 @@ async def phase_7b(batch_size: int, max_seq: int, kv_quant: str) -> dict:
         max_seq_len=max_seq,
         prefill_buckets=(64, 128),
         batch_size=batch_size,
-        chunk_len=16,
+        chunk_len=chunk_len,
     )
     t0 = time.monotonic()
     await eng7.start()
@@ -358,10 +371,12 @@ def main() -> None:
     ap.add_argument("--bs", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=256)
     ap.add_argument("--kv-quant", default="")
+    ap.add_argument("--chunk-len", type=int, default=16)
     ns = ap.parse_args()
 
     if ns.phase == "7b":
-        result = asyncio.run(phase_7b(ns.bs, ns.max_seq, ns.kv_quant))
+        result = asyncio.run(
+            phase_7b(ns.bs, ns.max_seq, ns.kv_quant, ns.chunk_len))
     elif ns.phase == "2b":
         result = asyncio.run(phase_2b())
     else:

@@ -75,6 +75,62 @@ def test_quantized_forward_close_to_dequantized_reference():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_embed_quant_roundtrip_and_tied_head():
+    from ai_agent_kubectl_tpu.ops.quant import (
+        embed_lookup, quantize_embed_int8, tied_head,
+    )
+
+    emb = jax.random.normal(jax.random.PRNGKey(3), (128, 32), jnp.float32)
+    qe = quantize_embed_int8(emb, chunk=50)      # exercise chunking
+    assert qe.q.shape == emb.shape and qe.scale.shape == (128, 1)
+    # Per-row error bound: half a step of that row's scale.
+    deq = np.asarray(qe.q, np.float32) * np.asarray(qe.scale)
+    assert np.all(np.abs(deq - np.asarray(emb))
+                  <= np.asarray(qe.scale) / 2 + 1e-7)
+
+    toks = jnp.asarray([[3, 77, 126]], jnp.int32)
+    looked = embed_lookup(qe, toks, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(looked), deq[np.asarray(toks)[0]][None],
+                               rtol=1e-6)
+
+    h = jax.random.normal(jax.random.PRNGKey(4), (1, 2, 32), jnp.float32)
+    logits = tied_head(h, qe)
+    ref = h @ jnp.asarray(deq).T
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_tied_embed_quantized_forward_close():
+    """Gemma-style tied/scaled embeddings with the per-row int8 embedding:
+    logits stay close to the dequantized-reference forward."""
+    from ai_agent_kubectl_tpu.models.config import get_config
+    from ai_agent_kubectl_tpu.models.transformer import (
+        KVCache, forward, init_params,
+    )
+    from ai_agent_kubectl_tpu.ops.quant import embed_lookup
+
+    cfg = get_config("toy-8m", tie_embeddings=True, embed_scale=True)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    qp = quantize_params_int8(params, quantize_embed=True)
+    assert isinstance(qp["embed"], QuantInt8)
+    deq = dict(qp)
+    deq["embed"] = embed_lookup(qp["embed"], jnp.arange(cfg.vocab_size),
+                                dtype=jnp.float32)
+    deq = jax.tree_util.tree_map(
+        lambda x: dequantize(x, jnp.float32) if isinstance(x, QuantInt8) else x,
+        deq, is_leaf=lambda x: isinstance(x, QuantInt8))
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                cfg.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(8), (1, 8)).astype(jnp.int32)
+    lq, _ = forward(qp, cfg, tokens, positions, KVCache.zeros(cfg, 1, 16,
+                                                              jnp.float32))
+    lr, _ = forward(deq, cfg, tokens, positions, KVCache.zeros(cfg, 1, 16,
+                                                               jnp.float32))
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(lr),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_quantized_params_shard_over_tp_mesh():
     from ai_agent_kubectl_tpu.models.config import get_config
     from ai_agent_kubectl_tpu.models.transformer import (

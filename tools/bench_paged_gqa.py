@@ -36,15 +36,15 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-MODEL = "llama-3-8b-instruct"
-BATCH = 16
+MODEL = os.environ.get("GQA_MODEL", "llama-3-8b-instruct")
+BATCH = int(os.environ.get("GQA_BATCH", "16"))
 # Live spans in this workload top out ≈ 420 tokens (bucket-256 prompt +
 # 160 generated); 512 halves the KV pool vs the first attempt's 1024,
 # which ran round 0 fine and then OOMed — int8-8B weights + a 2.1 GB pool
 # left no headroom for allocator churn on a 16 GB chip.
-MAX_SEQ = 512
-PAGE = 128
-ROUNDS = 3
+MAX_SEQ = int(os.environ.get("GQA_MAX_SEQ", "512"))
+PAGE = int(os.environ.get("GQA_PAGE", "128"))
+ROUNDS = int(os.environ.get("GQA_ROUNDS", "3"))
 
 
 def log(msg: str) -> None:
