@@ -271,7 +271,7 @@ async def phase_2b() -> dict:
     prefix_tokens = engine._prefix.n
     log(f"bench: prefix-KV cache ACTIVE ({prefix_tokens} tokens resident)")
 
-    warm = await ttft_phase(engine, n=3, tag="2b-warm")
+    warm = await ttft_phase(engine, n=20, tag="2b-warm")
     samples = await throughput_phase(
         engine, conc=conc, max_tokens=max_tokens, rounds=rounds, tag="2b")
     tok_s_chip = statistics.median(samples) / n_chips
@@ -291,6 +291,7 @@ async def phase_2b() -> dict:
         "tokenizer": os.path.basename(str(tok_path)),
         "tokens_per_sec_per_chip": round(tok_s_chip, 2),
         "single_stream_ttft_ms": warm["ttft_p50_ms"],
+        "single_stream_ttft_p99_ms": warm["ttft_p99_ms"],
     }
 
 
