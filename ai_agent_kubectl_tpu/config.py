@@ -136,8 +136,9 @@ class ServiceConfig:
     # int8 KV cache (ops/quant.py::QuantKV): halves the KV pool and the
     # per-step decode-attention HBM read — on HBM-capped single-chip
     # serving (7B-class) this doubles the decode batch that fits beside
-    # the weights. Single-device only (disabled with a warning under a
-    # mesh); DECODE_ATTN=paged falls back to the dense ladder.
+    # the weights. Composes with data/model/expert/seq mesh axes (QuantKV
+    # shards via shard_cache); disabled with a warning when pipe > 1, and
+    # DECODE_ATTN=paged falls back to the dense ladder.
     kv_quant: str = ""                      # KV_QUANT: "" | int8
     max_seq_len: int = 1024                 # MAX_SEQ_LEN
     max_new_tokens: int = 128               # MAX_NEW_TOKENS

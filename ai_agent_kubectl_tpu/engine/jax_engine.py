@@ -303,22 +303,25 @@ class JaxEngine:
 
     @property
     def _quantize_embed(self) -> bool:
-        """int8 embedding (per-row scales) rides with QUANT=int8, single-
-        device only — shard_params has no spec for the per-row scale leaf.
-        On tied-embedding models (Gemma) this halves the LM head's
-        per-step weight read; on all models it halves embedding HBM."""
-        return self.quant == "int8" and self.mesh is None
+        """int8 embedding (per-row scales) rides with QUANT=int8. On
+        tied-embedding models (Gemma) this halves the LM head's per-step
+        weight read; on all models it halves embedding HBM. Under a mesh
+        the QuantInt8 leaf shards exactly like the bf16 embedding
+        (vocab rows over ``model``; shard_params sanitizes the [V, 1]
+        scale with the same spec)."""
+        return self.quant == "int8"
 
     def _load(self) -> None:
         """Tokenizer + weights (checkpoint or random init). Shared by the
         single-sequence and batched engines."""
-        if self.kv_quant and self.mesh is not None:
-            # The sharding policy (parallel/sharding.py) and the pipeline /
-            # paged paths place plain [L,B,S,KV,hd] arrays; the QuantKV
-            # scale leaves don't have specs yet. Single-chip is where KV
-            # bytes cap batch size anyway (a mesh multiplies HBM).
-            logger.warning("KV_QUANT=int8 is single-device only for now; "
-                           "using %s KV under the mesh", self.dtype.__name__)
+        if self.kv_quant and self.mesh is not None \
+                and self.mesh.shape["pipe"] > 1:
+            # pipeline_layers' stage bodies read plain [L,B,S,KV,hd]
+            # arrays (models/transformer.py raises on a QuantKV cache in
+            # the pipe path); every other mesh shape shards QuantKV via
+            # shard_cache and serves int8 KV normally.
+            logger.warning("KV_QUANT=int8 does not compose with a pipe "
+                           "mesh axis; using %s KV", self.dtype.__name__)
             self.kv_quant = ""
         if self.kv_quant and self.attn_impl == "flash":
             # flash_attention_cached is a pallas_call: its operands must be

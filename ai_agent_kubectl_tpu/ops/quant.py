@@ -301,8 +301,9 @@ def quantize_params_int8(params: Dict[str, Any],
 
     ``quantize_embed`` additionally stores the embedding per-row int8
     (quantize_embed_int8) — halves the tied-head weight read and the
-    embedding's HBM. Opt-in: the engine enables it single-device only
-    (shard_params has no spec for the per-row scale leaf yet).
+    embedding's HBM. The engine enables it whenever QUANT=int8; under a
+    mesh the QuantInt8 leaf shards with the bf16 embedding's vocab-row
+    spec (shard_params sanitizes the [V, 1] scale against the same spec).
     """
     out = dict(params)
     layers = dict(params["layers"])

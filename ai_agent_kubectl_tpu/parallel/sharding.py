@@ -145,17 +145,29 @@ def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
 
 
 def shard_cache(cache, mesh: Mesh, cfg: ModelConfig):
-    """device_put a KVCache onto the mesh."""
+    """device_put a KVCache onto the mesh. int8 KV blocks
+    (ops/quant.py::QuantKV) place the payload with the full KV spec and
+    the per-(position, head) scales with the same spec minus the trailing
+    head_dim axis — ``sanitize_spec`` zips spec entries against the
+    4-dim scale shape, so the hd entry simply drops off."""
     from ..models.transformer import KVCache
 
     specs = cache_specs(cfg)
+
+    def _put_kv(block, spec):
+        from ..ops.quant import QuantKV
+
+        def put(a):
+            return jax.device_put(
+                a, NamedSharding(mesh, sanitize_spec(mesh, spec, a.shape)))
+
+        if isinstance(block, QuantKV):
+            return QuantKV(q=put(block.q), s=put(block.s))
+        return put(block)
+
     return KVCache(
-        k=jax.device_put(
-            cache.k, NamedSharding(mesh, sanitize_spec(mesh, specs["k"], cache.k.shape))
-        ),
-        v=jax.device_put(
-            cache.v, NamedSharding(mesh, sanitize_spec(mesh, specs["v"], cache.v.shape))
-        ),
+        k=_put_kv(cache.k, specs["k"]),
+        v=_put_kv(cache.v, specs["v"]),
         lengths=jax.device_put(
             cache.lengths,
             NamedSharding(mesh, sanitize_spec(mesh, specs["lengths"], cache.lengths.shape)),

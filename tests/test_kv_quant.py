@@ -121,12 +121,44 @@ async def test_int8_kv_paged_falls_back_to_dense():
         await eng.stop()
 
 
-def test_int8_kv_disabled_under_mesh():
+async def test_int8_kv_serves_under_mesh_with_parity(engines):
+    """int8 KV composes with data/model mesh axes: QuantKV shards via
+    shard_cache (payload [L,B,S,KV,hd] spec; scales the same minus hd)
+    and greedy serving matches the single-device int8-KV engine."""
+    from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+
     eng = BatchedJaxEngine(
         get_config("toy-8m"),
         dtype="float32",
         kv_quant="int8",
         mesh_shape="data:2,model:2",
+        max_seq_len=512,
+        prefill_buckets=(64, 128, 256, 512),
+        batch_size=4,
+        chunk_len=4,
+        compile_cache_dir="",
+    )
+    await eng.start()
+    try:
+        assert eng.kv_quant == "int8"
+        assert isinstance(eng._cache.k, QuantKV)
+        prompts = [render_prompt(f"get pods in ns mesh-{i}") for i in range(3)]
+        mesh_out = await asyncio.gather(*[
+            eng.generate(p, max_tokens=12, temperature=0.0) for p in prompts])
+        single_out = await asyncio.gather(*[
+            engines["int8"].generate(p, max_tokens=12, temperature=0.0)
+            for p in prompts])
+        assert [r.text for r in mesh_out] == [r.text for r in single_out]
+    finally:
+        await eng.stop()
+
+
+def test_int8_kv_disabled_under_pipe_mesh():
+    eng = BatchedJaxEngine(
+        get_config("toy-8m"),
+        dtype="float32",
+        kv_quant="int8",
+        mesh_shape="pipe:2,model:2",
         max_seq_len=128,
         prefill_buckets=(64,),
         batch_size=4,

@@ -131,6 +131,39 @@ def test_tied_embed_quantized_forward_close():
                                rtol=2e-4, atol=2e-4)
 
 
+async def test_int8_embed_serves_under_mesh_with_parity():
+    """quant=int8 now quantizes the embedding under a mesh too: the
+    vocab-sharded QuantInt8 gather + tied_head epilogue must serve with
+    greedy parity against the single-device int8 engine (tied and untied
+    covered via the two toy configs)."""
+    import asyncio as _a
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    for overrides in ({}, {"tie_embeddings": True, "embed_scale": True}):
+        cfg = get_config("toy-8m", **overrides)
+        outs = {}
+        for mesh_shape in ("", "data:2,model:2"):
+            eng = BatchedJaxEngine(
+                cfg, dtype="float32", quant="int8", mesh_shape=mesh_shape,
+                max_seq_len=128, prefill_buckets=(64,), batch_size=2,
+                chunk_len=4, compile_cache_dir="", prefix_cache=False,
+            )
+            await eng.start()
+            try:
+                from ai_agent_kubectl_tpu.ops.quant import QuantInt8
+                assert isinstance(eng.params["embed"], QuantInt8)
+                rs = await _a.gather(*[
+                    eng.generate(f"get pods -n team-{i}", max_tokens=8,
+                                 temperature=0.0)
+                    for i in range(3)])
+                outs[mesh_shape] = [r.text for r in rs]
+            finally:
+                await eng.stop()
+        assert outs[""] == outs["data:2,model:2"], overrides
+
+
 def test_quantized_params_shard_over_tp_mesh():
     from ai_agent_kubectl_tpu.models.config import get_config
     from ai_agent_kubectl_tpu.models.transformer import (

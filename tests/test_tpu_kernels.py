@@ -87,6 +87,44 @@ def test_compiled_paged_matches_dense_decode():
             np.asarray(ref).astype(np.float32), rtol=3e-2, atol=3e-2)
 
 
+def test_int8_kv_dequant_fuses_into_decode_attention():
+    """KV_QUANT=int8's whole decode-bandwidth claim (ops/quant.py) rests
+    on XLA fusing the int8→f32→bf16 convert+scale into the attention
+    matmuls' context reads. Compile a decode-shaped attention over a
+    dequantized context and assert no ENTRY-level instruction materializes
+    a full-context bf16/f32 tensor."""
+    import jax
+    import jax.numpy as jnp
+
+    from ai_agent_kubectl_tpu.ops.attention import dense_attention
+    from ai_agent_kubectl_tpu.ops.quant import QuantKV, kv_dequantize, kv_quantize
+
+    B, S, KV, hd, H = 48, 192, 16, 256, 16
+    k = kv_quantize(_rand((B, S, KV, hd), 10, jnp.float32))
+    v = kv_quantize(_rand((B, S, KV, hd), 11, jnp.float32))
+    q = _rand((B, 1, H, hd), 12, jnp.bfloat16)
+    positions = jnp.full((B, 1), S - 1, jnp.int32)
+
+    def decode_attn(q, k, v, positions):
+        k_ctx = kv_dequantize(k, q.dtype)
+        v_ctx = kv_dequantize(v, q.dtype)
+        mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]
+        return dense_attention(q, k_ctx, v_ctx, mask)
+
+    hlo = jax.jit(decode_attn).lower(q, k, v, positions).compile().as_text()
+    entry = hlo.split("ENTRY")[-1]
+    materialized = [
+        line.strip() for line in entry.splitlines()
+        if (f"= bf16[{B},{S},{KV},{hd}]" in line
+            or f"= f32[{B},{S},{KV},{hd}]" in line)
+        and "parameter" not in line
+    ]
+    assert not materialized, (
+        "int8 KV dequant materialized a full-precision context copy:\n"
+        + "\n".join(materialized)
+    )
+
+
 def test_int8_convert_fuses_into_weight_read():
     """The int8→bf16 convert in qmatmul must fuse into the dot's weight
     read — a materialized bf16 copy of the weight in the ENTRY computation

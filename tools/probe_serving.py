@@ -1,0 +1,125 @@
+"""Serving-path attribution probe (the tool behind PROFILE.md round 4).
+
+Two measurements `tools/profile_decode.py` can't make (it builds bf16
+params from scratch; this builds the REAL engine, including QUANT /
+KV_QUANT / prefix cache / scheduler):
+
+1. **Decode-chunk device ceiling**: chained dispatches of the engine's own
+   compiled batch-chunk programs, per KV-ladder bucket — the marginal
+   ms/step with host round trips amortized away, and the tok/s ceiling
+   the scheduler is chasing.
+2. **Burst attribution**: N concurrent requests through ``generate()``,
+   reporting group-admission counts and per-request queue/prefill/decode
+   spans — how much of wall-clock is ramp vs decode (this is the probe
+   that exposed the round-4 admission stagger and validated the
+   burst-ramp fix).
+
+Usage (on a TPU host; defaults reproduce the 7B north-star config):
+    python tools/probe_serving.py
+    python tools/probe_serving.py --model gemma-2b-it --dtype bfloat16 \
+        --quant "" --kv-quant "" --bs 64 --max-seq 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def log(msg: str) -> None:
+    print(msg, flush=True)
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gemma-7b-it")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--quant", default="int8")
+    ap.add_argument("--kv-quant", default="int8")
+    ap.add_argument("--bs", type=int, default=48)
+    ap.add_argument("--max-seq", type=int, default=192)
+    ap.add_argument("--chunk-len", type=int, default=16)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--reps", type=int, default=10,
+                    help="chained chunk dispatches per ceiling sample")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+    from ai_agent_kubectl_tpu.engine.tokenizer import HFTokenizer
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    cfg = get_config(args.model)
+    tok = HFTokenizer(
+        Path(__file__).resolve().parent.parent / "ai_agent_kubectl_tpu"
+        / "assets" / "tokenizer-k8s.json",
+        cfg.bos_id, cfg.eos_ids, cfg.pad_id)
+    buckets = tuple(b for b in (64, 128, 256, 512)
+                    if b <= args.max_seq) or (args.max_seq,)
+    eng = BatchedJaxEngine(
+        cfg, tokenizer=tok, dtype=args.dtype, quant=args.quant,
+        kv_quant=args.kv_quant, max_seq_len=args.max_seq,
+        prefill_buckets=buckets, batch_size=args.bs,
+        chunk_len=args.chunk_len)
+    t0 = time.monotonic()
+    await eng.start()
+    log(f"probe: engine ready in {time.monotonic() - t0:.0f}s "
+        f"(model={cfg.name} bs={args.bs} quant={args.quant or 'bf16'} "
+        f"kv={args.kv_quant or eng.dtype.__name__} "
+        f"kv_buckets={eng._kv_buckets})")
+
+    # ---- burst attribution (before the ceiling probe donates state) ----
+    for r in range(args.rounds):
+        g0 = eng._group_admitted
+        t0 = time.monotonic()
+        rs = await asyncio.gather(*[
+            eng.generate(render_prompt(f"list pods in ns probe-{r}-{i}"),
+                         max_tokens=args.max_tokens, temperature=0.0)
+            for i in range(args.bs)])
+        dt = time.monotonic() - t0
+        tot = sum(x.completion_tokens for x in rs)
+        mid = len(rs) // 2
+        qs = sorted(x.queue_ms for x in rs)
+        pf = sorted(x.prefill_ms for x in rs)
+        dm = sorted(x.decode_ms for x in rs)
+        log(f"probe[burst {r}]: {tot} tok in {dt:.2f}s = {tot/dt:.0f} tok/s"
+            f"  groups={eng._group_admitted - g0}"
+            f"  queue p50={qs[mid]:.0f}ms"
+            f"  admit-wait p0/p50/p100={pf[0]:.0f}/{pf[mid]:.0f}/{pf[-1]:.0f}ms"
+            f"  decode p50={dm[mid]:.0f}ms")
+
+    # ---- decode-chunk ceiling (stops the scheduler, drives programs) ----
+    await eng.stop()
+    cache, tokd, posd, temps = eng._cache, eng._tok_d, eng._pos_d, eng._temps_d
+    key = jax.random.PRNGKey(0)
+    active = jnp.ones((args.bs,), jnp.bool_)
+    for kv_b in eng._kv_buckets:
+        fn = eng._batch_chunk_fns[kv_b]
+        toks, tokd, posd, cache, key = fn(eng.params, tokd, posd, cache, key,
+                                          temps, active)
+        toks.block_until_ready()
+        t0 = time.monotonic()
+        outs = []
+        for _ in range(args.reps):
+            toks, tokd, posd, cache, key = fn(eng.params, tokd, posd, cache,
+                                              key, temps, active)
+            outs.append(toks)
+        outs[-1].block_until_ready()
+        dt = (time.monotonic() - t0) / args.reps
+        per_step = dt / eng.chunk_len * 1000
+        log(f"probe[ceiling]: kv_bucket={kv_b}: chunk={dt*1000:.1f}ms"
+            f" -> {per_step:.2f} ms/step"
+            f" -> {args.bs / per_step * 1000:.0f} tok/s device ceiling")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
