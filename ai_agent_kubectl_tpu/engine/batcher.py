@@ -444,7 +444,8 @@ class BatchedJaxEngine(JaxEngine):
     # ---------------------------------------------------------- scheduler
 
     def _worker_loop(self) -> None:
-        # Chunk pipeline, two deep: dispatch chunk N+1 (chained on device
+        # Chunk pipeline, CHUNK_PIPE_DEPTH deep (default 2): dispatch chunk
+        # N+1 (chained on device
         # arrays) before pulling chunk N's tokens, so the host↔device round
         # trip overlaps decode compute. The inflight queue carries two entry
         # kinds, consumed strictly FIFO:
@@ -486,11 +487,12 @@ class BatchedJaxEngine(JaxEngine):
                         and self._inflight[0][0] in ("first", "firsts")):
                     self._consume_oldest()
                     continue
-                if n_active > 0 and chunks_in_pipe < 2:
+                if n_active > 0 and chunks_in_pipe < self.CHUNK_PIPE_DEPTH:
                     # Burst ramp: slots a chunk is dispatched without can't
-                    # join it — a request that misses the first two
-                    # (speculative, ~0.5 s each on 7B geometry) chunks
-                    # starts >1 s late even though the whole burst arrived
+                    # join it — a request that misses the first
+                    # CHUNK_PIPE_DEPTH speculative chunks (~0.5 s each on
+                    # 7B geometry) starts >1 s late even though the whole
+                    # burst arrived
                     # within ~65 ms (round-4 probe). While admissions still
                     # show momentum (one landed within the last 30 ms) and
                     # free slots remain, nap briefly instead of dispatching
@@ -556,6 +558,14 @@ class BatchedJaxEngine(JaxEngine):
     #: hard cap on one continuous hold (re-armed momentum can't exceed it).
     ADMIT_RAMP_SECS = 0.03
     ADMIT_RAMP_MAX_SECS = 0.12
+
+    #: speculative decode chunks kept in flight ahead of the consumer.
+    #: 2 hides one fetch round trip behind one chunk of compute; depth 3
+    #: was A/B-ed on the round-4 bench link and did not help (the tunnel
+    #: delivers fetches in device order, so a deeper pipe only defers the
+    #: first token further) while wasting one more speculative chunk on
+    #: every tail. Kept a knob for locally-attached chips.
+    CHUNK_PIPE_DEPTH = 2
 
     @property
     def admit_kpads(self) -> tuple:

@@ -186,6 +186,51 @@ async def test_execute_endpoint(fake_kubectl, monkeypatch):
         await client.close()
 
 
+async def test_streaming_multi_turn_agent_loop(fake_kubectl, monkeypatch):
+    """BASELINE config 5's workload shape: a multi-turn agent loop —
+    stream a command token-by-token, execute it, feed the execution
+    result back into the next query, repeat. Exercises the SSE path and
+    /execute interleaved under one client session (the pattern a
+    kubectl agent drives), not just each endpoint in isolation."""
+    monkeypatch.setenv("FAKE_KUBECTL_MODE", "table")
+    client, engine = await make_client(make_cfg(), kubectl_binary=fake_kubectl)
+    try:
+        context = ""
+        commands = []
+        for turn, query in enumerate([
+            "list all pods",
+            "describe the first pod from: {ctx}",
+            "get logs for the pod in: {ctx}",
+        ]):
+            q = query.format(ctx=context[:80] or "default")
+            # -- stream the command (SSE) --
+            resp = await client.post("/kubectl-command/stream",
+                                     json={"query": q})
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            events, data = [], None
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if line.startswith("event: "):
+                    events.append(line.split(": ", 1)[1])
+                elif line.startswith("data: "):
+                    data = line.split(": ", 1)[1]
+            assert events[-1] == "done", (turn, events)
+            command = data
+            assert command.startswith("kubectl ")
+            commands.append(command)
+            # -- execute it, carry the result into the next turn --
+            resp = await client.post("/execute", json={"execute": command})
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["metadata"]["success"] is True
+            context = str(body["execution_result"]["data"])
+        assert len(commands) == 3 and len(set(commands)) >= 2
+        assert engine.calls == 3        # one generation per turn, no cache
+    finally:
+        await client.close()
+
+
 async def test_execute_timeout_structured(fake_kubectl, monkeypatch):
     monkeypatch.setenv("FAKE_KUBECTL_MODE", "slow")
     monkeypatch.setenv("FAKE_KUBECTL_SLEEP", "5")

@@ -48,6 +48,8 @@ async def main() -> None:
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--reps", type=int, default=10,
                     help="chained chunk dispatches per ceiling sample")
+    ap.add_argument("--pipe-depth", type=int, default=None,
+                    help="override CHUNK_PIPE_DEPTH for A/B runs")
     args = ap.parse_args()
 
     import jax
@@ -58,6 +60,9 @@ async def main() -> None:
     from ai_agent_kubectl_tpu.engine.tokenizer import HFTokenizer
     from ai_agent_kubectl_tpu.models.config import get_config
 
+    if args.pipe_depth is not None:
+        BatchedJaxEngine.CHUNK_PIPE_DEPTH = args.pipe_depth
+        log(f"probe: CHUNK_PIPE_DEPTH={args.pipe_depth}")
     cfg = get_config(args.model)
     tok = HFTokenizer(
         Path(__file__).resolve().parent.parent / "ai_agent_kubectl_tpu"
