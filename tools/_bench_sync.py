@@ -1,0 +1,21 @@
+"""Shared forced-sync helper for the bench/profile tools.
+
+``block_until_ready`` can no-op through the bench tunnel (only data
+fetches synchronize there — PROFILE.md r3), which silently turns timing
+loops into dispatch-rate measurements (a probe once reported a 1,477
+tok/s "ceiling" that way). Fetching one scalar forces a real sync at the
+cost of one RTT, amortized over the reps of the timing loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def force_sync(out) -> None:
+    """Really wait for ``out`` (array or pytree): block, then fetch one
+    scalar of the first leaf."""
+    jax.block_until_ready(out)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))

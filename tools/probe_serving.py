@@ -107,18 +107,18 @@ async def main() -> None:
     cache, tokd, posd, temps = eng._cache, eng._tok_d, eng._pos_d, eng._temps_d
     key = jax.random.PRNGKey(0)
     active = jnp.ones((args.bs,), jnp.bool_)
+    from _bench_sync import force_sync as _sync
+
     for kv_b in eng._kv_buckets:
         fn = eng._batch_chunk_fns[kv_b]
         toks, tokd, posd, cache, key = fn(eng.params, tokd, posd, cache, key,
                                           temps, active)
-        toks.block_until_ready()
+        _sync(toks)
         t0 = time.monotonic()
-        outs = []
         for _ in range(args.reps):
             toks, tokd, posd, cache, key = fn(eng.params, tokd, posd, cache,
                                               key, temps, active)
-            outs.append(toks)
-        outs[-1].block_until_ready()
+        _sync(toks)
         dt = (time.monotonic() - t0) / args.reps
         per_step = dt / eng.chunk_len * 1000
         log(f"probe[ceiling]: kv_bucket={kv_b}: chunk={dt*1000:.1f}ms"

@@ -26,6 +26,7 @@ from ai_agent_kubectl_tpu.models.config import get_config  # noqa: E402
 from ai_agent_kubectl_tpu.models.transformer import (  # noqa: E402
     KVCache, forward, init_params,
 )
+from _bench_sync import force_sync as _fetch_scalar  # noqa: E402
 
 
 def log(msg):
@@ -36,15 +37,35 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gemma-2b-it")
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--quant", default="", choices=["", "int8"],
+                    help="int8 weights+embedding (random_params_int8 — "
+                         "how 7B-class models fit the chip)")
+    ap.add_argument("--kv-quant", default="", choices=["", "int8"])
     ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--max-seq", type=int, default=1024)
+    ap.add_argument("--bs-list", default="8,16,32,64",
+                    help="decode batch sizes to sweep (trim for 7B HBM)")
     ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--chunks-only", action="store_true",
+                    help="skip the standalone-piece timings (the isolated "
+                         "256k-vocab int8 head compile can wedge the bench "
+                         "tunnel's remote-compile helper; the chunk "
+                         "sections carry the attribution)")
     args = ap.parse_args()
 
     cfg = get_config(args.model)
     dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[args.dtype]
-    log(f"profile: {cfg.name} on {jax.devices()[0].platform}, dtype={dtype.__name__}")
+    log(f"profile: {cfg.name} on {jax.devices()[0].platform}, "
+        f"dtype={dtype.__name__} quant={args.quant or '-'} "
+        f"kv_quant={args.kv_quant or '-'}")
 
-    params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    if args.quant == "int8":
+        from ai_agent_kubectl_tpu.ops.quant import random_params_int8
+
+        params = random_params_int8(jax.random.PRNGKey(0), cfg, dtype=dtype,
+                                    quantize_embed=True)
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     n_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
     log(f"params: {n_bytes/1e9:.2f} GB")
 
@@ -57,7 +78,7 @@ def main():
     t = timeit(lambda: read_weights(params), args.reps)
     log(f"weight-read floor: {t:.2f} ms  ({n_bytes/1e9/t*1000:.0f} GB/s)")
 
-    S_alloc = 1024 + args.chunk
+    S_alloc = args.max_seq + args.chunk
 
     def make_chunk(N, kv_limit, sample: str):
         """Engine-identical decode chunk with ablations.
@@ -86,46 +107,64 @@ def main():
     def run_chunk(N, kv_limit, sample="engine", reps=args.reps):
         fn = make_chunk(N, kv_limit, sample)
         tok = jnp.zeros((N, 1), jnp.int32)
-        pos = jnp.full((N, 1), 320, jnp.int32)   # bench-realistic position
-        cache = KVCache.zeros(cfg, N, S_alloc, dtype=dtype)
+        # Start positions so every timed step's KV write stays IN BOUNDS:
+        # (reps+1) chunks run against an S_alloc cache, and out-of-bounds
+        # scatter rows are silently dropped — which would time a step
+        # without its cache-write traffic. Prefer the bench-realistic
+        # mid-life position (320) when the cache is long enough.
+        pos0 = max(0, min(320, S_alloc - (reps + 1) * args.chunk - 1))
+        pos = jnp.full((N, 1), pos0, jnp.int32)
+        cache = KVCache.zeros(cfg, N, S_alloc, dtype=dtype,
+                              kv_quant=args.kv_quant)
         key = jax.random.PRNGKey(0)
         temps = jnp.zeros((N,), jnp.float32)
         active = jnp.ones((N,), jnp.bool_)
         toks, tok, pos, cache, key = fn(params, tok, pos, cache, key,
                                         temps, active)   # compile
-        toks.block_until_ready()
+        _fetch_scalar(toks)
         t0 = time.perf_counter()
         for _ in range(reps):
             toks, tok, pos, cache, key = fn(params, tok, pos, cache, key,
                                             temps, active)
-        toks.block_until_ready()
+        _fetch_scalar(toks)
         ms = (time.perf_counter() - t0) / reps
         return ms * 1000 / args.chunk  # per decode step
 
-    log("\n-- decode chunk: ms/step (engine-identical) --")
-    for N in (8, 16, 32, 64):
-        per = run_chunk(N, 512)
-        log(f"bs={N:3d} kv=512 : {per:7.2f} ms/step = "
+    bs_list = tuple(int(b) for b in args.bs_list.split(","))
+    kv_mid = min(512, S_alloc)
+    log(f"\n-- decode chunk: ms/step (engine-identical, kv={kv_mid}) --")
+    for N in bs_list:
+        per = run_chunk(N, kv_mid)
+        log(f"bs={N:3d} kv={kv_mid} : {per:7.2f} ms/step = "
             f"{N/per*1000:6.0f} tok/s")
 
-    log("\n-- kv-span sweep at bs=32 --")
-    for kv in (128, 256, 512, S_alloc):
-        per = run_chunk(32, kv)
-        log(f"bs=32 kv={kv:5d}: {per:7.2f} ms/step = {32/per*1000:6.0f} tok/s")
+    bs_mid = bs_list[len(bs_list) // 2]
+    log(f"\n-- kv-span sweep at bs={bs_mid} --")
+    for kv in sorted({128, 256, kv_mid, S_alloc}):
+        if kv > S_alloc:
+            continue
+        per = run_chunk(bs_mid, kv)
+        log(f"bs={bs_mid} kv={kv:5d}: {per:7.2f} ms/step = "
+            f"{bs_mid/per*1000:6.0f} tok/s")
 
-    log("\n-- ablations at bs=32 kv=512 --")
-    base = run_chunk(32, 512, "engine")
-    norng = run_chunk(32, 512, "argmax")
+    log(f"\n-- ablations at bs={bs_mid} kv={kv_mid} --")
+    base = run_chunk(bs_mid, kv_mid, "engine")
+    norng = run_chunk(bs_mid, kv_mid, "argmax")
     log(f"engine sampling : {base:7.2f} ms/step")
     log(f"argmax, no RNG  : {norng:7.2f} ms/step  (sampling+rng = {base-norng:+.2f})")
+
+    if args.chunks_only:
+        return
 
     # ---- standalone pieces ----
     h = jax.random.normal(jax.random.PRNGKey(1), (32, cfg.dim), dtype)
     embed = params["embed"]
 
+    from ai_agent_kubectl_tpu.ops.quant import tied_head
+
     @jax.jit
     def head(h):
-        return (h @ embed.astype(h.dtype).T).astype(jnp.float32)
+        return tied_head(h, embed).astype(jnp.float32)
 
     t = timeit(lambda: head(h), args.reps)
     log(f"\nlogits head [32,{cfg.dim}]x[{cfg.vocab_size},{cfg.dim}]^T: {t:.2f} ms")
@@ -145,23 +184,28 @@ def main():
     t = timeit(lambda: split(key), args.reps)
     log(f"key split: {t:.2f} ms")
 
-    # ---- admission prefill (prefix-hit suffix: bucket 64 @ kv 384) ----
+    # ---- admission prefill (prefix-hit suffix: bucket 64 @ kv 384,
+    # clamped to the cache for short --max-seq geometries) ----
+    pf_kv = min(384, args.max_seq)
+    pf_off = max(0, min(273, args.max_seq - 65))
+
     def prefill(params, tokens, positions, cache, mask):
         return forward(params, cfg, tokens, positions, cache,
-                       kv_limit=384, attn_impl="dense", token_mask=mask)
+                       kv_limit=pf_kv, attn_impl="dense", token_mask=mask)
 
     pf = jax.jit(prefill, donate_argnums=(3,))
     tokens = jnp.zeros((1, 64), jnp.int32)
-    positions = jnp.broadcast_to(273 + jnp.arange(64), (1, 64)).astype(jnp.int32)
+    positions = jnp.broadcast_to(pf_off + jnp.arange(64), (1, 64)).astype(jnp.int32)
     mask = jnp.ones((1, 64), jnp.float32)
-    cache1 = KVCache.zeros(cfg, 1, 1024, dtype=dtype)
+    cache1 = KVCache.zeros(cfg, 1, args.max_seq, dtype=dtype,
+                           kv_quant=args.kv_quant)
     logits_pf, cache1 = pf(params, tokens, positions, cache1, mask)
     logits_pf.block_until_ready()
     t0 = time.perf_counter()
     for _ in range(args.reps):
         logits_pf, cache1 = pf(params, tokens, positions, cache1, mask)
     logits_pf.block_until_ready()
-    log(f"suffix prefill b64@kv384 B=1: "
+    log(f"suffix prefill b64@kv{pf_kv} B=1: "
         f"{(time.perf_counter()-t0)/args.reps*1000:.2f} ms")
 
     # ---- dispatch overhead: trivial jitted op round trip ----
@@ -176,11 +220,11 @@ def main():
 
 def timeit(fn, reps):
     out = fn()
-    jax.block_until_ready(out)
+    _fetch_scalar(out)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn()
-    jax.block_until_ready(out)
+    _fetch_scalar(out)
     return (time.perf_counter() - t0) / reps * 1000
 
 
