@@ -54,6 +54,27 @@ class QuantInt8:
         return self.q.nbytes + self.scale.nbytes
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QuantInt8W8A8:
+    """Same payload/scales as QuantInt8, but ``qmatmul`` additionally
+    quantizes the ACTIVATIONS per token and runs the dot s8×s8→s32 on the
+    MXU (W8A8): the int8 weight feeds the MXU directly instead of being
+    converted to bf16 first. Round-4 attribution measured the int8→bf16
+    convert pacing the weight stream at roughly half the bf16 byte rate —
+    this leaf type is the lever that removes the convert. Accuracy: adds
+    per-token symmetric activation error (~0.5%) on top of the weight
+    quantization; the type lives in the param tree, so the mode is static
+    per compiled program."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+
 def quantize_int8(w: jnp.ndarray) -> QuantInt8:
     """Symmetric int8, one scale per (batch..., output channel): only the
     contraction axis (-2) is reduced, so stacked-layer weights [L, in, out]
@@ -115,9 +136,22 @@ def tied_head(h: jnp.ndarray, emb) -> jnp.ndarray:
 
 
 def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
-    """x @ w for plain or QuantInt8 weights (w [in, out], scale [1, out]).
-    The dequant multiply sits in the matmul epilogue (one fused multiply
-    per output element)."""
+    """x @ w for plain, QuantInt8, or QuantInt8W8A8 weights
+    (w [in, out], scale [1, out]). The dequant multiply sits in the
+    matmul epilogue (one fused multiply per output element)."""
+    if isinstance(w, QuantInt8W8A8):
+        # Per-token symmetric activation quantization, s8×s8→s32 MXU dot,
+        # both scales in the f32 epilogue.
+        ax = jnp.max(jnp.abs(x), axis=-1, keepdims=True).astype(jnp.float32)
+        sx = jnp.maximum(ax / 127.0, 1e-12)
+        xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx),
+                      -127, 127).astype(jnp.int8)
+        y = jax.lax.dot_general(
+            xq, w.q,
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return (y.astype(jnp.float32) * sx * w.scale[0]).astype(x.dtype)
     if isinstance(w, QuantInt8):
         y = jax.lax.dot_general(
             x, w.q.astype(x.dtype),
@@ -216,6 +250,21 @@ def kv_broadcast_rows(src, n: int):
 def kv_prefix_trim(kv, p: int):
     """Trim a KV block to its first ``p`` sequence positions."""
     return jax.tree.map(lambda a: a[:, :, :p], kv)
+
+
+def to_w8a8(params):
+    """Re-tag the LAYER projections' QuantInt8 leaves as QuantInt8W8A8
+    (same payload and scales — only qmatmul's dispatch changes). The
+    embedding/head stay weight-only: their outputs are the logits, where
+    activation-quant noise directly moves the argmax."""
+    out = dict(params)
+    out["layers"] = jax.tree_util.tree_map(
+        lambda x: (QuantInt8W8A8(q=x.q, scale=x.scale)
+                   if isinstance(x, QuantInt8) else x),
+        params["layers"],
+        is_leaf=lambda x: isinstance(x, QuantInt8),
+    )
+    return out
 
 
 #: projection weights eligible for quantization (matmul RHS with the

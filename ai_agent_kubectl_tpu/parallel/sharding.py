@@ -123,13 +123,14 @@ def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
     (ops/quant.py::QuantInt8) shard their payload with the original
     weight's spec; the per-output-channel scales follow it (size-1 axes
     sanitize to replicated, the channel axis inherits the sharding)."""
-    from ..ops.quant import QuantInt8
+    from ..ops.quant import QuantInt8, QuantInt8W8A8
 
     specs = param_specs(cfg)
+    qtypes = (QuantInt8, QuantInt8W8A8)
 
     def _put(leaf, spec):
-        if isinstance(leaf, QuantInt8):
-            return QuantInt8(
+        if isinstance(leaf, qtypes):
+            return type(leaf)(
                 q=jax.device_put(leaf.q, NamedSharding(
                     mesh, sanitize_spec(mesh, spec, leaf.q.shape))),
                 scale=jax.device_put(leaf.scale, NamedSharding(
@@ -140,7 +141,7 @@ def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
 
     return jax.tree_util.tree_map(
         _put, params, specs,
-        is_leaf=lambda x: isinstance(x, QuantInt8),
+        is_leaf=lambda x: isinstance(x, qtypes),
     )
 
 

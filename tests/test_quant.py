@@ -75,6 +75,44 @@ def test_quantized_forward_close_to_dequantized_reference():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_w8a8_qmatmul_close_to_weight_only():
+    """QuantInt8W8A8 (per-token activation quant + s8×s8 MXU dot) stays
+    within ~1% of the weight-only dequant reference. Measured a speed
+    no-op on the 7B geometry (PROFILE.md r4) — kept as a library option."""
+    from ai_agent_kubectl_tpu.ops.quant import QuantInt8W8A8, to_w8a8
+
+    w = jax.random.normal(jax.random.PRNGKey(5), (64, 32), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 8, 64), jnp.float32)
+    qw = quantize_int8(w)
+    out = qmatmul(x, QuantInt8W8A8(q=qw.q, scale=qw.scale))
+    ref = x @ dequantize(qw, jnp.float32)
+    rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    assert rel < 0.02, rel
+
+    # to_w8a8 re-tags layer projections only; embed/head stay weight-only.
+    from ai_agent_kubectl_tpu.models.config import get_config
+    from ai_agent_kubectl_tpu.models.transformer import init_params
+
+    params = quantize_params_int8(
+        init_params(jax.random.PRNGKey(0), get_config("toy-8m"),
+                    dtype=jnp.float32),
+        quantize_embed=True)
+    p88 = to_w8a8(params)
+    assert isinstance(p88["layers"]["wq"], QuantInt8W8A8)
+    assert isinstance(p88["embed"], QuantInt8)
+    assert isinstance(p88["lm_head"], QuantInt8)
+
+    # shard_params must treat the W8A8 leaf like QuantInt8 (tree-structure
+    # mismatch regression: its tree_map descended into the node).
+    from ai_agent_kubectl_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ai_agent_kubectl_tpu.parallel.sharding import shard_params
+
+    mesh = build_mesh(MeshConfig.parse("data:2,model:2"),
+                      devices=jax.devices()[:4])
+    sp = shard_params(p88, mesh, get_config("toy-8m"))
+    assert isinstance(sp["layers"]["wq"], QuantInt8W8A8)
+
+
 def test_embed_quant_roundtrip_and_tied_head():
     from ai_agent_kubectl_tpu.ops.quant import (
         embed_lookup, quantize_embed_int8, tied_head,

@@ -37,9 +37,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gemma-2b-it")
     ap.add_argument("--dtype", default="bfloat16")
-    ap.add_argument("--quant", default="", choices=["", "int8"],
+    ap.add_argument("--quant", default="", choices=["", "int8", "w8a8"],
                     help="int8 weights+embedding (random_params_int8 — "
-                         "how 7B-class models fit the chip)")
+                         "how 7B-class models fit the chip); w8a8 "
+                         "additionally runs layer matmuls s8xs8 on the MXU")
     ap.add_argument("--kv-quant", default="", choices=["", "int8"])
     ap.add_argument("--chunk", type=int, default=16)
     ap.add_argument("--max-seq", type=int, default=1024)
@@ -59,11 +60,13 @@ def main():
         f"dtype={dtype.__name__} quant={args.quant or '-'} "
         f"kv_quant={args.kv_quant or '-'}")
 
-    if args.quant == "int8":
-        from ai_agent_kubectl_tpu.ops.quant import random_params_int8
+    if args.quant in ("int8", "w8a8"):
+        from ai_agent_kubectl_tpu.ops.quant import random_params_int8, to_w8a8
 
         params = random_params_int8(jax.random.PRNGKey(0), cfg, dtype=dtype,
                                     quantize_embed=True)
+        if args.quant == "w8a8":
+            params = to_w8a8(params)
     else:
         params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     n_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
@@ -112,6 +115,13 @@ def main():
         # scatter rows are silently dropped — which would time a step
         # without its cache-write traffic. Prefer the bench-realistic
         # mid-life position (320) when the cache is long enough.
+        if S_alloc < (reps + 1) * args.chunk + 1:
+            raise SystemExit(
+                f"--max-seq {args.max_seq} too short for reps={reps} × "
+                f"chunk={args.chunk}: timed KV writes would run out of "
+                f"bounds (silently dropped scatters time a step without "
+                f"its cache-write traffic). Lower --reps/--chunk or raise "
+                f"--max-seq.")
         pos0 = max(0, min(320, S_alloc - (reps + 1) * args.chunk - 1))
         pos = jnp.full((N, 1), pos0, jnp.int32)
         cache = KVCache.zeros(cfg, N, S_alloc, dtype=dtype,
@@ -193,6 +203,10 @@ def main():
         return forward(params, cfg, tokens, positions, cache,
                        kv_limit=pf_kv, attn_impl="dense", token_mask=mask)
 
+    if args.max_seq < 65:
+        log("suffix prefill: skipped (--max-seq < 65 cannot hold the "
+            "64-token bucket in bounds)")
+        return
     pf = jax.jit(prefill, donate_argnums=(3,))
     tokens = jnp.zeros((1, 64), jnp.int32)
     positions = jnp.broadcast_to(pf_off + jnp.arange(64), (1, 64)).astype(jnp.int32)
@@ -200,11 +214,11 @@ def main():
     cache1 = KVCache.zeros(cfg, 1, args.max_seq, dtype=dtype,
                            kv_quant=args.kv_quant)
     logits_pf, cache1 = pf(params, tokens, positions, cache1, mask)
-    logits_pf.block_until_ready()
+    _fetch_scalar(logits_pf)
     t0 = time.perf_counter()
     for _ in range(args.reps):
         logits_pf, cache1 = pf(params, tokens, positions, cache1, mask)
-    logits_pf.block_until_ready()
+    _fetch_scalar(logits_pf)
     log(f"suffix prefill b64@kv{pf_kv} B=1: "
         f"{(time.perf_counter()-t0)/args.reps*1000:.2f} ms")
 
