@@ -157,6 +157,9 @@ class ServiceConfig:
     # long while work is in flight (hung device dispatch), the engine is
     # marked degraded and every waiting request is failed. 0 disables.
     engine_watchdog_secs: float = 120.0     # ENGINE_WATCHDOG_SECS
+    # Graceful shutdown: stop accepting new requests, wait up to this long
+    # for in-flight generations to finish, then abort what remains.
+    drain_timeout_secs: float = 10.0        # DRAIN_TIMEOUT_SECS
     # Persistent XLA compilation cache: warm restarts skip the multi-second
     # per-program compiles (engine startup drops from ~80s to seconds).
     # Empty string disables.
@@ -224,6 +227,7 @@ class ServiceConfig:
             kv_page_size=_env_int("KV_PAGE_SIZE", 16),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
             engine_watchdog_secs=_env_float("ENGINE_WATCHDOG_SECS", 120.0),
+            drain_timeout_secs=_env_float("DRAIN_TIMEOUT_SECS", 10.0),
             compile_cache_dir=os.getenv(
                 "COMPILE_CACHE_DIR", "~/.cache/ai-agent-kubectl-tpu/xla-cache"
             ),

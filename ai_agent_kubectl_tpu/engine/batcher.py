@@ -119,6 +119,7 @@ class BatchedJaxEngine(JaxEngine):
         self._last_progress = time.monotonic()
         self._last_admit_t = 0.0   # burst-ramp momentum (see _worker_loop)
         self._ramp_hold_t0 = None  # when the current ramp hold engaged
+        self._stopping = False     # drain in progress (see stop())
 
     @classmethod
     def from_config(cls, cfg) -> "BatchedJaxEngine":
@@ -148,6 +149,7 @@ class BatchedJaxEngine(JaxEngine):
 
     def _start_blocking(self) -> None:
         t0 = time.monotonic()
+        self._stopping = False       # support stop() → start() restarts
         self._setup_compile_cache()
         self._setup_mesh()
         self._load()
@@ -408,8 +410,27 @@ class BatchedJaxEngine(JaxEngine):
             logger.exception("batch-admission warm failed; "
                              "single-admission fallback stays")
 
-    async def stop(self) -> None:
-        self._ready = False
+    async def stop(self, drain_secs: float = 0.0) -> None:
+        self._ready = False          # new generate() calls now 503
+        self._stopping = True        # watchdog must not re-mark ready
+        if drain_secs > 0:
+            # Drain: the scheduler keeps running, finishing active slots
+            # and admitting anything already queued; we only tear down
+            # once the system is empty or the deadline passes (remaining
+            # work is then aborted by the shutdown path below). Racy reads
+            # of scheduler-owned state are fine for a poll.
+            deadline = time.monotonic() + drain_secs
+            while time.monotonic() < deadline:
+                # getattr: _slots/_inflight only exist after a successful
+                # start(); cleanup after a failed startup must not mask
+                # the original error with an AttributeError here.
+                busy = (any(s is not None
+                            for s in getattr(self, "_slots", ()))
+                        or not self._admissions.empty()
+                        or bool(getattr(self, "_inflight", ())))
+                if not busy:
+                    break
+                await asyncio.sleep(0.05)
         self._running = False
         self._shutdown = True
         if self._worker is not None:
@@ -957,7 +978,11 @@ class BatchedJaxEngine(JaxEngine):
                 # stay failed, but new traffic can be served.
                 logger.warning("engine watchdog: scheduler progress "
                                "resumed; re-marking engine ready")
-                self._ready = True
+                # Never re-open admissions while stop() is draining: the
+                # whole point of the drain is that new traffic 503s and
+                # the LB retries elsewhere.
+                if not self._stopping:
+                    self._ready = True
                 fired = False
 
     def _watchdog_check(self) -> bool:

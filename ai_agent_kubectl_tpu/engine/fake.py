@@ -55,7 +55,7 @@ class FakeEngine:
     async def start(self) -> None:
         self._ready = True
 
-    async def stop(self) -> None:
+    async def stop(self, drain_secs: float = 0.0) -> None:
         self._ready = False
 
     def _answer(self, prompt: str) -> str:

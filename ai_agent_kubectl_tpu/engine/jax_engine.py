@@ -592,8 +592,12 @@ class JaxEngine:
         except Exception:  # pragma: no cover - warm is best-effort
             logger.exception("ladder warm failed; top-bucket fallback stays")
 
-    async def stop(self) -> None:
-        self._ready = False
+    async def stop(self, drain_secs: float = 0.0) -> None:
+        self._ready = False          # new generate() calls now 503
+        if drain_secs > 0 and self._lock is not None:
+            deadline = time.monotonic() + drain_secs
+            while self._lock.locked() and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
         self._shutdown = True
         if self._ladder_thread is not None:
             # A compile in flight at interpreter teardown aborts the
@@ -1054,6 +1058,13 @@ class JaxEngine:
         t_queue0 = time.monotonic()
         deadline = (t_queue0 + timeout) if timeout else None
         async with self._lock:
+            # Re-check after the (possibly long) lock wait: stop()'s drain
+            # polls _lock.locked(), and in the release→waiter-resume
+            # handoff gap it can observe the lock free, finish the drain,
+            # and tear down — a waiter must not then start a generation
+            # against a stopped engine.
+            if self._shutdown or not self._ready:
+                raise EngineUnavailable("engine stopped")
             queue_ms = (time.monotonic() - t_queue0) * 1000.0
             loop = asyncio.get_running_loop()
             cancel = threading.Event()

@@ -10,6 +10,7 @@ temperature=0 default matches app.py:109.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 from typing import AsyncIterator, Optional
@@ -34,12 +35,16 @@ class OpenAICompatEngine:
         self.base_url = (base_url or "https://api.openai.com/v1").rstrip("/")
         self.timeout = timeout
         self._client: Optional[httpx.AsyncClient] = None
+        self._inflight = 0
+        self._draining = False
 
     @property
     def ready(self) -> bool:
-        return self._client is not None and bool(self.api_key)
+        return (self._client is not None and bool(self.api_key)
+                and not self._draining)
 
     async def start(self) -> None:
+        self._draining = False
         headers = {}
         if self.api_key:
             headers["Authorization"] = f"Bearer {self.api_key}"
@@ -47,7 +52,14 @@ class OpenAICompatEngine:
             base_url=self.base_url, headers=headers, timeout=self.timeout
         )
 
-    async def stop(self) -> None:
+    async def stop(self, drain_secs: float = 0.0) -> None:
+        # Drain: stop accepting (ready drops), wait for in-flight proxied
+        # requests before closing the shared httpx client under them.
+        self._draining = True
+        if drain_secs > 0:
+            deadline = time.monotonic() + drain_secs
+            while self._inflight > 0 and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
         if self._client is not None:
             await self._client.aclose()
             self._client = None
@@ -60,9 +72,12 @@ class OpenAICompatEngine:
         temperature: float = 0.0,
         timeout: Optional[float] = None,
     ) -> EngineResult:
-        if self._client is None or not self.api_key:
-            raise EngineUnavailable("OpenAI engine not initialized (missing key?)")
+        if self._client is None or not self.api_key or self._draining:
+            raise EngineUnavailable("OpenAI engine not initialized (missing key?)"
+                                    if not self._draining else
+                                    "engine draining")
         t0 = time.monotonic()
+        self._inflight += 1
         try:
             resp = await self._client.post(
                 "/chat/completions",
@@ -80,6 +95,8 @@ class OpenAICompatEngine:
             # Connect/read/protocol failures map to the same degraded-mode
             # exception as initialization failures (reference 503 path).
             raise EngineUnavailable(f"upstream request failed: {e}") from e
+        finally:
+            self._inflight -= 1
         if resp.status_code >= 400:
             # Same mapping as the streaming path: upstream HTTP errors are
             # engine unavailability, not an internal 500.
@@ -109,8 +126,11 @@ class OpenAICompatEngine:
     ) -> AsyncIterator[str]:
         """True token streaming: ``stream: true`` ChatCompletions request,
         SSE ``data:`` chunks parsed incrementally (delta.content pieces)."""
-        if self._client is None or not self.api_key:
-            raise EngineUnavailable("OpenAI engine not initialized (missing key?)")
+        if self._client is None or not self.api_key or self._draining:
+            raise EngineUnavailable("OpenAI engine not initialized (missing key?)"
+                                    if not self._draining else
+                                    "engine draining")
+        self._inflight += 1
         try:
             async with self._client.stream(
                 "POST",
@@ -163,3 +183,5 @@ class OpenAICompatEngine:
             # keying fallback on engine exception types catch them, matching
             # the initialization and >=400 paths above.
             raise EngineUnavailable(f"upstream stream failed: {e}") from e
+        finally:
+            self._inflight -= 1

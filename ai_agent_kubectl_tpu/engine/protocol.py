@@ -65,8 +65,13 @@ class Engine(Protocol):
         """Load weights, compile, warm up. Must be called before generate."""
         ...
 
-    async def stop(self) -> None:
-        """Graceful drain/shutdown."""
+    async def stop(self, drain_secs: float = 0.0) -> None:
+        """Graceful drain/shutdown.
+
+        With ``drain_secs > 0`` the engine first stops accepting work
+        (``ready`` drops, new ``generate`` calls raise EngineUnavailable)
+        and waits up to that long for in-flight requests to finish before
+        tearing down; 0 aborts them immediately."""
         ...
 
     async def generate(

@@ -478,7 +478,11 @@ def create_app(cfg: ServiceConfig, engine: Engine,
         await app["service"].engine.start()
 
     async def _stop_engine(app: web.Application) -> None:
-        await app["service"].engine.stop()
+        # Graceful drain (SURVEY.md §5 failure-detection row): readiness
+        # drops first (health → 503, LBs stop routing), in-flight
+        # generations get up to DRAIN_TIMEOUT_SECS to finish, then the
+        # remainder is aborted.
+        await app["service"].engine.stop(drain_secs=cfg.drain_timeout_secs)
 
     app.on_startup.append(_start_engine)
     app.on_cleanup.append(_stop_engine)

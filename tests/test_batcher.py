@@ -42,6 +42,37 @@ def single():
     asyncio.run(eng.stop())
 
 
+async def test_graceful_drain_finishes_inflight_and_rejects_new():
+    """stop(drain_secs=...) lets an in-flight generation complete while
+    new submissions are rejected (readiness drops first) — the graceful
+    drain SURVEY.md §5 plans against the reference's abort-only teardown."""
+    from ai_agent_kubectl_tpu.engine.protocol import EngineUnavailable
+
+    eng = BatchedJaxEngine(
+        get_config("toy-8m"),
+        dtype="float32",
+        max_seq_len=256,
+        prefill_buckets=(64, 128),
+        batch_size=2,
+        chunk_len=4,
+        compile_cache_dir="",
+        prefix_cache=False,
+    )
+    await eng.start()
+    inflight = asyncio.create_task(
+        eng.generate("list pods with a longish generation",
+                     max_tokens=40, temperature=0.0))
+    await asyncio.sleep(0.2)            # let it admit and start decoding
+    stop_task = asyncio.create_task(eng.stop(drain_secs=30.0))
+    await asyncio.sleep(0.05)           # readiness has dropped
+    with pytest.raises(EngineUnavailable):
+        await eng.generate("rejected during drain", max_tokens=4,
+                           temperature=0.0)
+    result = await inflight             # drained, not aborted
+    assert result.completion_tokens > 0
+    await stop_task
+
+
 async def test_greedy_parity_with_single_engine(batched, single):
     prompt = "list all pods in kube-system"
     a = await batched.generate(prompt, max_tokens=24, temperature=0.0)
