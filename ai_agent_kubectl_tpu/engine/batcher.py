@@ -120,6 +120,10 @@ class BatchedJaxEngine(JaxEngine):
         self._last_admit_t = 0.0   # burst-ramp momentum (see _worker_loop)
         self._ramp_hold_t0 = None  # when the current ramp hold engaged
         self._stopping = False     # drain in progress (see stop())
+        self._admitting = 0        # requests popped but not yet slotted —
+                                   # drain must count them as busy (an
+                                   # admission's prefill can run for
+                                   # seconds on the scheduler thread)
 
     @classmethod
     def from_config(cls, cfg) -> "BatchedJaxEngine":
@@ -377,6 +381,13 @@ class BatchedJaxEngine(JaxEngine):
         its own scratch state — never touches live scheduler buffers; each
         shape is published to _batch_ready only after its first execution,
         so the scheduler can never block on a half-compiled program."""
+        try:
+            # Long-prompt offset programs first (prefix-independent; the
+            # batched engine never runs the single-sequence ladder warm).
+            self._warm_chunked_prefill_offsets()
+        except Exception:  # pragma: no cover - warm is best-effort
+            logger.exception("chunked-prefill warm failed; long prompts "
+                             "compile on first use")
         if self._prefix is None:
             return
         try:
@@ -427,6 +438,7 @@ class BatchedJaxEngine(JaxEngine):
                 busy = (any(s is not None
                             for s in getattr(self, "_slots", ()))
                         or not self._admissions.empty()
+                        or self._admitting > 0
                         or bool(getattr(self, "_inflight", ())))
                 if not busy:
                     break
@@ -547,7 +559,11 @@ class BatchedJaxEngine(JaxEngine):
                     req = self._admissions.get(timeout=0.05)
                 except _queue.Empty:
                     continue
-                self._admit_one(req)
+                self._admitting += 1
+                try:
+                    self._admit_one(req)
+                finally:
+                    self._admitting -= 1
             except Exception:  # pragma: no cover - scheduler must survive
                 logger.exception("batch scheduler error; failing active slots")
                 self._inflight.clear()
@@ -616,6 +632,17 @@ class BatchedJaxEngine(JaxEngine):
                 break
         if not pending:
             return
+        # Popped-but-not-yet-slotted requests are invisible to both the
+        # slot scan and the queue — count them so a concurrent drain
+        # (stop(drain_secs)) doesn't tear down under an admission whose
+        # cold prefill can run for seconds on this thread.
+        self._admitting += len(pending)
+        try:
+            self._admit_popped(pending)
+        finally:
+            self._admitting -= len(pending)
+
+    def _admit_popped(self, pending: List[_Request]) -> None:
         # Every request popped off the queue MUST reach either a slot or an
         # error event — an exception mid-burst (e.g. OOM allocating the
         # group scratch) may not silently drop the rest of the burst, or

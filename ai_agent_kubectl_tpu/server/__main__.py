@@ -3,6 +3,8 @@
 
 from __future__ import annotations
 
+import asyncio
+
 from aiohttp import web
 
 from ..config import ServiceConfig
@@ -33,7 +35,38 @@ def main() -> None:
     engine = build_engine(cfg)
     app = create_app(cfg, engine)
     logger.info("Starting server on %s:%s (engine=%s)", cfg.host, cfg.port, cfg.engine)
-    web.run_app(app, host=cfg.host, port=cfg.port, access_log=None)
+    asyncio.run(_serve(cfg, app, logger))
+
+
+async def _serve(cfg: ServiceConfig, app: web.Application, logger) -> None:
+    """Run the site with a drain-aware shutdown: on SIGTERM/SIGINT the
+    listening socket STAYS OPEN while the engine stops accepting —
+    /health answers 503 so load balancers drain us, and in-flight
+    generations get DRAIN_TIMEOUT_SECS to finish — and only then does the
+    runner tear down. (aiohttp's run_app closes the socket before any
+    shutdown hook runs, so LBs would see connection-refused instead of
+    the 503 drain; reference behavior is an immediate kill, app.py:392.)"""
+    import signal
+
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, cfg.host, cfg.port)
+    await site.start()
+
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop_ev.set)
+        except NotImplementedError:  # pragma: no cover - non-POSIX
+            pass
+    await stop_ev.wait()
+    logger.info("Shutdown signal: draining (up to %.0fs) while still "
+                "answering health checks", cfg.drain_timeout_secs)
+    await app["service"].engine.stop(drain_secs=cfg.drain_timeout_secs)
+    # on_cleanup's engine.stop() runs again inside cleanup(); it is
+    # idempotent and returns immediately on an already-stopped engine.
+    await runner.cleanup()
 
 
 if __name__ == "__main__":

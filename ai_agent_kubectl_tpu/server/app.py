@@ -478,11 +478,13 @@ def create_app(cfg: ServiceConfig, engine: Engine,
         await app["service"].engine.start()
 
     async def _stop_engine(app: web.Application) -> None:
-        # Graceful drain (SURVEY.md §5 failure-detection row): readiness
-        # drops first (health → 503, LBs stop routing), in-flight
-        # generations get up to DRAIN_TIMEOUT_SECS to finish, then the
-        # remainder is aborted.
-        await app["service"].engine.stop(drain_secs=cfg.drain_timeout_secs)
+        # The DRAIN_TIMEOUT_SECS drain itself runs at signal time in
+        # server/__main__.py::_serve, while the socket still answers
+        # health checks (aiohttp closes the socket before cleanup hooks
+        # run, so a drain here could never 503 to the LB). This hook is
+        # the final teardown — idempotent after a drain, and the only
+        # stop for embedded/test usages that never send a signal.
+        await app["service"].engine.stop()
 
     app.on_startup.append(_start_engine)
     app.on_cleanup.append(_stop_engine)

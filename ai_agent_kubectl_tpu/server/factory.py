@@ -31,7 +31,7 @@ class DegradedEngine:
     async def start(self) -> None:
         logger.error("Engine degraded: %s", self.reason)
 
-    async def stop(self) -> None:
+    async def stop(self, drain_secs: float = 0.0) -> None:
         pass
 
     async def generate(self, prompt, **kw) -> EngineResult:

@@ -87,6 +87,36 @@ async def test_batched_engine_serves_long_prompts():
     assert short.completion_tokens >= 1
 
 
+@pytest.mark.parametrize("cls,thread_attr", [
+    (JaxEngine, "_ladder_thread"),
+    (BatchedJaxEngine, "_batch_warm_thread"),   # the batcher never runs
+                                                # the single-seq ladder warm
+])
+async def test_background_warm_compiles_chunked_prefill_ladder(cls,
+                                                               thread_attr):
+    """Both engines' background warm threads pre-compile the multi-offset
+    suffix programs _prefill_chunked dispatches, so the first long prompt
+    pays device time, not ~19–65 s of serial compiles (measured cold on
+    the r4 bench chip at max_seq 4096)."""
+    kw = {"batch_size": 2, "chunk_len": 4} if cls is BatchedJaxEngine else {}
+    eng = _mk(cls, (32, 64), compile_cache_dir="", **kw)
+    await eng.start()
+    try:
+        deadline = asyncio.get_event_loop().time() + 300
+        t = getattr(eng, thread_attr, None)
+        while t is not None and t.is_alive():
+            await asyncio.sleep(0.2)
+            assert asyncio.get_event_loop().time() < deadline
+        # max_seq 384, big bucket 64 → offset programs at kv 128..384.
+        warmed = [k for k in eng._suffix_prefill_fns
+                  if k[0] == 64 and k[1] > 64]
+        assert warmed, "no offset suffix programs warmed"
+        r = await eng.generate(LONG_PROMPT, max_tokens=4, temperature=0.0)
+        assert r.completion_tokens > 0
+    finally:
+        await eng.stop()
+
+
 async def test_overlong_prompt_still_left_truncates_at_capacity():
     # Beyond KV capacity itself (max_seq - budget) the tail is kept.
     eng = _mk(JaxEngine, (64,))
