@@ -73,6 +73,33 @@ async def test_graceful_drain_finishes_inflight_and_rejects_new():
     await stop_task
 
 
+async def test_restart_after_drained_stop():
+    """stop(drain_secs) → start() must fully re-arm the engine (the
+    _stopping drain flag would otherwise keep the watchdog from ever
+    re-marking it ready)."""
+    eng = BatchedJaxEngine(
+        get_config("toy-8m"),
+        dtype="float32",
+        max_seq_len=128,
+        prefill_buckets=(64,),
+        batch_size=2,
+        chunk_len=4,
+        compile_cache_dir="",
+        prefix_cache=False,
+    )
+    await eng.start()
+    r1 = await eng.generate("get pods", max_tokens=4, temperature=0.0)
+    await eng.stop(drain_secs=5)
+    assert eng._stopping
+    await eng.start()
+    try:
+        assert not eng._stopping and eng.ready
+        r2 = await eng.generate("get pods", max_tokens=4, temperature=0.0)
+        assert r1.text == r2.text
+    finally:
+        await eng.stop()
+
+
 async def test_greedy_parity_with_single_engine(batched, single):
     prompt = "list all pods in kube-system"
     a = await batched.generate(prompt, max_tokens=24, temperature=0.0)
