@@ -143,13 +143,23 @@ class ServiceConfig:
     max_seq_len: int = 1024                 # MAX_SEQ_LEN
     max_new_tokens: int = 128               # MAX_NEW_TOKENS
     decode_batch_size: int = 8              # DECODE_BATCH_SIZE (continuous batching slots)
+    # Decode-chunk length: tokens generated per jitted chunk dispatch.
+    # Larger chunks amortize dispatch overhead but admit new requests at
+    # coarser granularity (TTFT under load). 16 is the bench-proven value
+    # (chunk 32 measured -15% throughput and 2x TTFT; BENCH_r04).
+    chunk_len: int = 16                     # CHUNK_LEN
+    # Speculative decode chunks kept in flight ahead of the consumer. 2
+    # hides one fetch round trip behind one chunk of compute; 3 measured
+    # slower through the bench tunnel. Raise only for locally-attached
+    # chips with fast host links.
+    chunk_pipe_depth: int = 2               # CHUNK_PIPE_DEPTH
     prefill_buckets: str = "64,128,256,512,1024"  # PREFILL_BUCKETS (padded prefill shapes)
     temperature: float = 0.0                # TEMPERATURE (0 == greedy, matches app.py:109)
     attn_impl: str = "auto"                 # ATTN_IMPL: auto | dense | flash (prefill kernel)
     # Decode attention: "paged" reads only each slot's live KV pages
-    # (ops/paged_attention.py) — opt-in for GQA models / ragged
-    # long-context batches with KV_PAGE_SIZE >= 64. "auto" resolves to
-    # dense-over-KV-bucket (faster on MQA-class models, measured).
+    # (ops/paged_attention.py). "auto" picks paged for GQA models on TPU
+    # (measured 2.08x on Llama-3-8B bs=32, raising KV_PAGE_SIZE to >= 64)
+    # and dense-over-KV-bucket for MQA/MHA (faster there, measured).
     decode_attn: str = "auto"               # DECODE_ATTN: auto | dense | paged
     kv_page_size: int = 16                  # KV_PAGE_SIZE (paged attention)
     hbm_prefix_cache: bool = True           # HBM_PREFIX_CACHE (system-prompt prefix KV)
@@ -220,6 +230,8 @@ class ServiceConfig:
             max_seq_len=_env_int("MAX_SEQ_LEN", 1024),
             max_new_tokens=_env_int("MAX_NEW_TOKENS", 128),
             decode_batch_size=_env_int("DECODE_BATCH_SIZE", 8),
+            chunk_len=_env_int("CHUNK_LEN", 16),
+            chunk_pipe_depth=_env_int("CHUNK_PIPE_DEPTH", 2),
             prefill_buckets=_env_str("PREFILL_BUCKETS", "64,128,256,512,1024"),
             temperature=_env_float("TEMPERATURE", 0.0),
             attn_impl=(_env_str("ATTN_IMPL", "auto") or "auto").lower(),

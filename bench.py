@@ -12,15 +12,16 @@ terms (VERDICT r3 item 1):
   prefix/suffix bucket profile and TTFT path match production token
   lengths. ``BENCH_TOKENIZER`` overrides the asset path; set it to a real
   Gemma/Llama tokenizer.json when one is available.
-- **Gemma-7B phase** (the north-star model): int8 weight-only (bf16 ~17 GB
-  does not fit one chip's HBM), with a **TTFT distribution over 50
+- **Gemma-7B phase** (the north-star model): quantized weights (bf16
+  ~17 GB does not fit one chip's HBM), with a **TTFT distribution over 50
   single-stream requests** (p50/p99) plus a **device-side TTFT estimate**
   (marginal time of back-to-back prefill+sample dispatches, which strips
   the constant host→device round trip — the tunnel — out of the figure).
-  Decode is weight-read-bound (int8 7B ≈ 8.6 GB ⇒ ~16 ms/step floor), so
-  batch size is the throughput lever: a ladder tries bs=32 @ max_seq 192
-  first and falls back (16, then 8) if the KV pool + admission scratch
-  don't fit beside the weights. Skipped off-TPU.
+  Decode is weight-read-bound, so weight bytes and batch size are the
+  throughput levers: ``LADDER_7B`` tries bs=48 @ max_seq 192 with int8 KV
+  first and falls back ((32, 192, int8 KV), then (16, 256) and (8, 256)
+  with bf16 KV) if the KV pool + admission scratch don't fit beside the
+  weights. Skipped off-TPU.
 - **Gemma-2B phase** (BASELINE config 2 geometry, v5e-1): bf16 random-init,
   bs=64 — the headline tok/s/chip number (continuity with rounds 1–3).
 

@@ -67,6 +67,41 @@ async def test_long_prompt_served_chunked_up_to_capacity(engine):
     assert result.prompt_tokens == engine.max_seq_len - 4
 
 
+async def test_drain_completes_queued_waiter():
+    """stop(drain_secs) must finish a request that was accepted and is
+    QUEUED on the engine lock — not just the one holding it (ADVICE r4:
+    the lock-polling drain 503'd queued work). New requests after the
+    drain starts are rejected immediately."""
+    import asyncio
+
+    from ai_agent_kubectl_tpu.engine.protocol import EngineUnavailable
+
+    eng = JaxEngine(
+        get_config("toy-8m"),
+        dtype="float32",
+        max_seq_len=256,
+        prefill_buckets=(64,),
+        seed=0,
+        compile_cache_dir="",
+        prefix_cache=False,
+    )
+    await eng.start()
+    holder = asyncio.create_task(
+        eng.generate("first request", max_tokens=12))
+    await asyncio.sleep(0.05)          # holder owns the lock
+    queued = asyncio.create_task(
+        eng.generate("second request", max_tokens=4))
+    await asyncio.sleep(0.01)          # queued is waiting on the lock
+    stop = asyncio.create_task(eng.stop(drain_secs=30.0))
+    await asyncio.sleep(0.01)          # drain began: _ready is now False
+    with pytest.raises(EngineUnavailable):
+        await eng.generate("late request", max_tokens=2)
+    r1, r2 = await asyncio.gather(holder, queued)
+    assert r1.completion_tokens > 0 and r2.completion_tokens > 0
+    await stop
+    assert eng._gen_inflight == 0
+
+
 async def test_engine_not_started_raises():
     from ai_agent_kubectl_tpu.engine.protocol import EngineUnavailable
 

@@ -60,9 +60,6 @@ async def main() -> None:
     from ai_agent_kubectl_tpu.engine.tokenizer import HFTokenizer
     from ai_agent_kubectl_tpu.models.config import get_config
 
-    if args.pipe_depth is not None:
-        BatchedJaxEngine.CHUNK_PIPE_DEPTH = args.pipe_depth
-        log(f"probe: CHUNK_PIPE_DEPTH={args.pipe_depth}")
     cfg = get_config(args.model)
     tok = HFTokenizer(
         Path(__file__).resolve().parent.parent / "ai_agent_kubectl_tpu"
@@ -70,11 +67,13 @@ async def main() -> None:
         cfg.bos_id, cfg.eos_ids, cfg.pad_id)
     buckets = tuple(b for b in (64, 128, 256, 512)
                     if b <= args.max_seq) or (args.max_seq,)
+    extra = ({"chunk_pipe_depth": args.pipe_depth}
+             if args.pipe_depth is not None else {})
     eng = BatchedJaxEngine(
         cfg, tokenizer=tok, dtype=args.dtype, quant=args.quant,
         kv_quant=args.kv_quant, max_seq_len=args.max_seq,
         prefill_buckets=buckets, batch_size=args.bs,
-        chunk_len=args.chunk_len)
+        chunk_len=args.chunk_len, **extra)
     t0 = time.monotonic()
     await eng.start()
     log(f"probe: engine ready in {time.monotonic() - t0:.0f}s "
