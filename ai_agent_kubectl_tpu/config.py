@@ -129,16 +129,18 @@ class ServiceConfig:
 
     # --- engine knobs ---
     dtype: str = "bfloat16"                 # DTYPE
-    # Weight-only int8 quantization (ops/quant.py): halves projection
-    # weight bytes — decode is weight-read-bound, so near-proportional
-    # throughput for large dense models. "" disables.
-    quant: str = ""                         # QUANT: "" | int8
+    # Weight-only quantization: int8 (ops/quant.py) halves projection
+    # weight bytes; int4 (ops/quant4.py, Pallas packed-nibble matmul,
+    # group-wise scales) halves them again — decode is weight-read-bound,
+    # so near-proportional throughput for large dense models. int4 is
+    # single-chip only (falls back to int8 under a mesh). "" disables.
+    quant: str = ""                         # QUANT: "" | int8 | int4
     # int8 KV cache (ops/quant.py::QuantKV): halves the KV pool and the
     # per-step decode-attention HBM read — on HBM-capped single-chip
     # serving (7B-class) this doubles the decode batch that fits beside
-    # the weights. Composes with data/model/expert/seq mesh axes (QuantKV
-    # shards via shard_cache); disabled with a warning when pipe > 1, and
-    # DECODE_ATTN=paged falls back to the dense ladder.
+    # the weights. Composes with every mesh axis incl. pipe (the stage
+    # bodies tree-map QuantKV); DECODE_ATTN=paged falls back to the dense
+    # ladder (the paged kernel reads bf16 KV).
     kv_quant: str = ""                      # KV_QUANT: "" | int8
     max_seq_len: int = 1024                 # MAX_SEQ_LEN
     max_new_tokens: int = 128               # MAX_NEW_TOKENS
@@ -161,6 +163,11 @@ class ServiceConfig:
     # (measured 2.08x on Llama-3-8B bs=32, raising KV_PAGE_SIZE to >= 64)
     # and dense-over-KV-bucket for MQA/MHA (faster there, measured).
     decode_attn: str = "auto"               # DECODE_ATTN: auto | dense | paged
+    # MoE dispatch: "auto" uses expert-parallel all-to-all dispatch when
+    # the mesh has expert>1, dense all-experts otherwise; "ep" forces the
+    # dispatch path (a 1-device expert mesh is built if needed — how one
+    # chip serves the real EP program); "dense" forces all-experts.
+    moe_impl: str = "auto"                  # MOE_IMPL: auto | ep | dense
     kv_page_size: int = 16                  # KV_PAGE_SIZE (paged attention)
     hbm_prefix_cache: bool = True           # HBM_PREFIX_CACHE (system-prompt prefix KV)
     # Scheduler watchdog: if the batch scheduler makes no progress for this
@@ -236,6 +243,7 @@ class ServiceConfig:
             temperature=_env_float("TEMPERATURE", 0.0),
             attn_impl=(_env_str("ATTN_IMPL", "auto") or "auto").lower(),
             decode_attn=(_env_str("DECODE_ATTN", "auto") or "auto").lower(),
+            moe_impl=(_env_str("MOE_IMPL", "auto") or "auto").lower(),
             kv_page_size=_env_int("KV_PAGE_SIZE", 16),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
             engine_watchdog_secs=_env_float("ENGINE_WATCHDOG_SECS", 120.0),

@@ -190,6 +190,7 @@ class BatchedJaxEngine(JaxEngine):
             max_seq_len=cfg.max_seq_len,
             prefill_buckets=cfg.prefill_bucket_list,
             attn_impl=cfg.attn_impl,
+            moe_impl=cfg.moe_impl,
             prefix_cache=cfg.hbm_prefix_cache,
             mesh_shape=cfg.mesh_shape,
             dcn_mesh_shape=cfg.dcn_mesh_shape,
@@ -303,6 +304,7 @@ class BatchedJaxEngine(JaxEngine):
                                         kv_limit=kv_limit,
                                         attn_impl=self._decode_impl,
                                         mesh=self.mesh,
+                                        moe_impl=self.moe_impl,
                                         token_mask=active[:, None],
                                         page_size=self.kv_page_size)
                 key, sub = jax.random.split(key)
@@ -799,6 +801,7 @@ class BatchedJaxEngine(JaxEngine):
                 logits, cache = forward(params, cfg, tokens, positions,
                                         cache, kv_limit=kv_limit,
                                         attn_impl=impl, mesh=self.mesh,
+                                        moe_impl=self.moe_impl,
                                         token_mask=mask,
                                         logits_at=lengths - 1)
                 first = sample_tokens_batched(logits[:, 0], key, temperatures)

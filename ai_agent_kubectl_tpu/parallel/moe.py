@@ -42,18 +42,39 @@ def router_weights(cfg: ModelConfig, logits: jnp.ndarray):
     return mix, top_idx
 
 
+def _qeinsum(spec: str, x: jnp.ndarray, w, scale_shape: str) -> jnp.ndarray:
+    """einsum with an optionally int8-quantized RHS ([E, in, out] with
+    per-(expert, out-channel) scales [E, 1, out]). The dequant multiply
+    sits in the einsum epilogue in f32 — same contract as
+    ops/quant.py::qmatmul, so only int8 bytes cross HBM for the expert
+    weights. ``scale_shape`` tells how to broadcast the [E, out] scales
+    onto the result: "ef_last2" for results [..., E, out] (dense_moe's
+    [B, S, E, F]) or "e_lead" for results [E, ..., out] (the EP shard's
+    [E_local, C, out])."""
+    from ..ops.quant import QuantInt8
+
+    if not isinstance(w, QuantInt8):
+        return jnp.einsum(spec, x, w)
+    y = jnp.einsum(spec, x, w.q.astype(x.dtype))
+    s = w.scale.squeeze(-2)                                # [E, out]
+    if scale_shape == "e_lead":
+        s = w.scale                                        # [E, 1, out]
+    return (y.astype(jnp.float32) * s).astype(x.dtype)
+
+
 def dense_moe(cfg: ModelConfig, lp: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
     """All-experts evaluation: x [B, S, D] -> [B, S, D].
 
-    w_gate/w_up: [E, D, F], w_down: [E, F, D], router: [D, E].
-    """
+    w_gate/w_up: [E, D, F], w_down: [E, F, D], router: [D, E] — the
+    projections may be QuantInt8 (per-(expert, out-channel) scales; the
+    router never is)."""
     logits = (x @ lp["router"]).astype(jnp.float32)               # [B, S, E]
     mix, _ = router_weights(cfg, logits)
 
-    gate = jnp.einsum("bsd,edf->bsef", x, lp["w_gate"])
-    up = jnp.einsum("bsd,edf->bsef", x, lp["w_up"])
+    gate = _qeinsum("bsd,edf->bsef", x, lp["w_gate"], "ef_last2")
+    up = _qeinsum("bsd,edf->bsef", x, lp["w_up"], "ef_last2")
     hidden = _act(cfg, gate) * up                                 # [B, S, E, F]
-    y = jnp.einsum("bsef,efd->bsed", hidden, lp["w_down"])        # [B, S, E, D]
+    y = _qeinsum("bsef,efd->bsed", hidden, lp["w_down"], "ef_last2")
     return jnp.einsum("bsed,bse->bsd", y.astype(jnp.float32),
                       mix).astype(x.dtype)
 
@@ -67,10 +88,12 @@ def _act(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _ffn(cfg: ModelConfig, w_gate, w_up, w_down, x):
-    """Batched per-expert FFN: x [E_local, C, D] -> [E_local, C, D]."""
-    gate = jnp.einsum("ecd,edf->ecf", x, w_gate)
-    up = jnp.einsum("ecd,edf->ecf", x, w_up)
-    return jnp.einsum("ecf,efd->ecd", _act(cfg, gate) * up, w_down)
+    """Batched per-expert FFN: x [E_local, C, D] -> [E_local, C, D].
+    Weights may be QuantInt8 — the dequant stays in each einsum's
+    epilogue (VERDICT r4 item 3: int8 experts inside the EP dispatch)."""
+    gate = _qeinsum("ecd,edf->ecf", x, w_gate, "e_lead")
+    up = _qeinsum("ecd,edf->ecf", x, w_up, "e_lead")
+    return _qeinsum("ecf,efd->ecd", _act(cfg, gate) * up, w_down, "e_lead")
 
 
 def _ep_shard(x, mask, router, w_gate, w_up, w_down, *, cfg: ModelConfig,
@@ -163,11 +186,26 @@ def expert_parallel_moe(
     if token_mask is None:
         token_mask = jnp.ones((B, S), jnp.float32)
 
+    def _wspec(w, qspec):
+        """Per-leaf specs for an optionally-quantized expert weight: the
+        int8 payload takes the weight's spec; the [E, 1, out] scales
+        shard expert + out-channel only (their size-1 contraction axis
+        can never take the model axis a row-parallel payload does)."""
+        from ..ops.quant import QuantInt8
+
+        if not isinstance(w, QuantInt8):
+            return qspec
+        sspec = P(qspec[0], None,
+                  qspec[2] if len(qspec) > 2 else None)
+        return QuantInt8(q=qspec, scale=sspec)
+
     fn = jax.shard_map(
         partial(_ep_shard, cfg=cfg, axis=axis,
                 model_axis=model_axis if use_tp else None, capacity=capacity),
         mesh=mesh,
-        in_specs=(P(axis, None), P(axis), P(), col, col, row),
+        in_specs=(P(axis, None), P(axis), P(),
+                  _wspec(lp["w_gate"], col), _wspec(lp["w_up"], col),
+                  _wspec(lp["w_down"], row)),
         out_specs=P(axis, None),
     )
     flat = fn(x.reshape(T, D), token_mask.reshape(T), lp["router"],

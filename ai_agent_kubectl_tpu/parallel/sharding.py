@@ -123,14 +123,21 @@ def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
     (ops/quant.py::QuantInt8) shard their payload with the original
     weight's spec; the per-output-channel scales follow it (size-1 axes
     sanitize to replicated, the channel axis inherits the sharding)."""
+    import dataclasses as _dc
+
     from ..ops.quant import QuantInt8, QuantInt8W8A8
+    from ..ops.quant4 import QuantInt4
 
     specs = param_specs(cfg)
-    qtypes = (QuantInt8, QuantInt8W8A8)
+    qtypes = (QuantInt8, QuantInt8W8A8, QuantInt4)
 
     def _put(leaf, spec):
         if isinstance(leaf, qtypes):
-            return type(leaf)(
+            # Payload and scales follow the original weight's spec
+            # (sanitize_spec drops axes that no longer divide — e.g. an
+            # int4 packed out/2 axis or a group-count axis under TP).
+            return _dc.replace(
+                leaf,
                 q=jax.device_put(leaf.q, NamedSharding(
                     mesh, sanitize_spec(mesh, spec, leaf.q.shape))),
                 scale=jax.device_put(leaf.scale, NamedSharding(

@@ -473,6 +473,11 @@ def create_app(cfg: ServiceConfig, engine: Engine,
     app.router.add_post("/debug/trace", handle_debug_trace)
     app.router.add_get("/health", handle_health)
     app.router.add_get("/metrics", handle_metrics)
+    # /openapi.json + /docs — unauthenticated like the reference's
+    # FastAPI-generated docs (app.py:131); see server/openapi.py.
+    from .openapi import register as register_openapi
+
+    register_openapi(app)
 
     async def _start_engine(app: web.Application) -> None:
         await app["service"].engine.start()

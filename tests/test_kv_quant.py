@@ -153,7 +153,11 @@ async def test_int8_kv_serves_under_mesh_with_parity(engines):
         await eng.stop()
 
 
-def test_int8_kv_disabled_under_pipe_mesh():
+def test_int8_kv_stays_enabled_under_pipe_mesh():
+    """Round 5 closed the int8-KV x pipe composition gap (VERDICT r4
+    item 2): a pipe mesh now serves a QuantKV cache instead of silently
+    falling back to full-precision KV. (Greedy parity is pinned by
+    tests/test_mesh_serving.py::test_batched_serving_pp_tp_int8_kv_parity.)"""
     eng = BatchedJaxEngine(
         get_config("toy-8m"),
         dtype="float32",
@@ -168,7 +172,7 @@ def test_int8_kv_disabled_under_pipe_mesh():
     )
     asyncio.run(eng.start())
     try:
-        assert eng.kv_quant == ""          # gated off with a warning
-        assert not isinstance(eng._cache.k, QuantKV)
+        assert eng.kv_quant == "int8"
+        assert isinstance(eng._cache.k, QuantKV)
     finally:
         asyncio.run(eng.stop())

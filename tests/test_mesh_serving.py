@@ -87,6 +87,28 @@ async def test_batched_serving_dp_ep_tp_mesh_greedy_parity():
         await eng.stop()
 
 
+async def test_moe_impl_ep_single_device_parity():
+    """MOE_IMPL=ep on a single device (VERDICT r4 item 3): the engine
+    builds a 1-device expert mesh and serves through the REAL
+    expert-parallel dispatch program (degenerate all_to_alls) with greedy
+    parity vs the dense evaluation — the path the scaled-Mixtral chip
+    bench now exercises."""
+    ref = await _serve(_batched(""))
+
+    eng = _batched("")
+    eng.moe_impl = "ep"
+    await eng.start()
+    try:
+        assert eng.mesh is not None
+        assert eng.mesh.shape["expert"] == 1
+        out = await asyncio.gather(*[
+            eng.generate(p, max_tokens=8, temperature=0.0) for p in PROMPTS
+        ])
+        assert [r.text for r in out] == ref
+    finally:
+        await eng.stop()
+
+
 async def test_single_seq_engine_tp_mesh_parity():
     """The single-sequence engine under a pure-TP mesh (toy dense model)
     matches its single-device output."""
@@ -165,6 +187,39 @@ async def test_batched_serving_pp_tp_mesh_greedy_parity():
         out = await asyncio.gather(*[
             eng.generate(p, max_tokens=8, temperature=0.0) for p in PROMPTS
         ])
+        assert [r.text for r in out] == ref
+    finally:
+        await eng.stop()
+
+
+async def test_batched_serving_pp_tp_int8_kv_parity():
+    """int8 KV x pipeline parallelism (VERDICT r4 item 2): the pp=2,tp=2
+    serving path reads/writes a QuantKV cache through the pipeline stage
+    bodies with exact greedy parity vs the single-device bf16-KV engine.
+    This is the 70B-shaped composition (BASELINE row 5): the config whose
+    KV pool most needs int8 is the pipelined one."""
+    ref = await _serve(_batched_dense(""))
+
+    eng = _batched_dense("pp=2,tp=2", kv_quant="int8")
+    await eng.start()
+    try:
+        from ai_agent_kubectl_tpu.ops.quant import QuantKV
+
+        assert eng.kv_quant == "int8"          # no silent fallback
+        assert isinstance(eng._cache.k, QuantKV)
+        # Both QuantKV leaves (payload and scales) are layer-sharded over
+        # the pipe axis.
+        assert (eng._cache.k.q.addressable_shards[0].data.shape[0]
+                == eng._cache.k.q.shape[0] // 2)
+        assert (eng._cache.k.s.addressable_shards[0].data.shape[0]
+                == eng._cache.k.s.shape[0] // 2)
+
+        out = await asyncio.gather(*[
+            eng.generate(p, max_tokens=8, temperature=0.0) for p in PROMPTS
+        ])
+        # int8 KV quantization error is far below greedy decision
+        # boundaries on the toy model: exact parity expected (the same
+        # contract tests/test_kv_quant.py pins single-device).
         assert [r.text for r in out] == ref
     finally:
         await eng.stop()

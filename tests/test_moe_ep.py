@@ -108,6 +108,48 @@ def test_ep_tp_sharded_ffn_matches_dense():
                                rtol=2e-5, atol=2e-5)
 
 
+def _quantize_experts(lp):
+    from ai_agent_kubectl_tpu.ops.quant import quantize_int8
+
+    out = dict(lp)
+    for k in ("w_gate", "w_up", "w_down"):
+        out[k] = quantize_int8(lp[k])
+    return out
+
+
+def test_dense_moe_int8_experts_close_to_full():
+    """int8 expert weights through dense_moe (VERDICT r4 item 3): the
+    per-(expert, out-channel) dequant epilogue keeps outputs close to the
+    full-precision mixture."""
+    cfg = get_config("toy-moe")
+    lp = _layer0(cfg)
+    x = _x(cfg, 2, 8)
+    full = np.asarray(dense_moe(cfg, lp, x))
+    q = np.asarray(dense_moe(cfg, _quantize_experts(lp), x))
+    rel = np.abs(q - full).max() / (np.abs(full).max() + 1e-9)
+    assert rel < 0.02, f"int8 expert rel err {rel}"
+
+
+@pytest.mark.parametrize("mesh_axes", [dict(expert=4),
+                                       dict(expert=2, model=2)])
+def test_ep_int8_experts_match_dense_int8(mesh_axes):
+    """The EP all-to-all dispatch with QuantInt8 expert weights (payload
+    + scales sharded per-leaf through the shard_map) matches the dense
+    evaluation of the SAME quantized weights exactly — quantization
+    commutes with dispatch."""
+    cfg = get_config("toy-moe")
+    lp = _quantize_experts(_layer0(cfg))
+    x = _x(cfg, 2, 8)
+    n = 1
+    for v in mesh_axes.values():
+        n *= v
+    mesh = build_mesh(MeshConfig(**mesh_axes), devices=jax.devices()[:n])
+    out = expert_parallel_moe(cfg, lp, x, mesh, capacity=16)
+    ref = dense_moe(cfg, lp, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_ep_rejects_indivisible():
     cfg = get_config("toy-moe")
     lp = _layer0(cfg)

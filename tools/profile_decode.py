@@ -37,10 +37,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gemma-2b-it")
     ap.add_argument("--dtype", default="bfloat16")
-    ap.add_argument("--quant", default="", choices=["", "int8", "w8a8"],
+    ap.add_argument("--quant", default="",
+                    choices=["", "int8", "w8a8", "int4"],
                     help="int8 weights+embedding (random_params_int8 — "
                          "how 7B-class models fit the chip); w8a8 "
-                         "additionally runs layer matmuls s8xs8 on the MXU")
+                         "additionally runs layer matmuls s8xs8 on the MXU; "
+                         "int4 packs projections to nibbles served by the "
+                         "Pallas kernel (ops/quant4.py)")
     ap.add_argument("--kv-quant", default="", choices=["", "int8"])
     ap.add_argument("--chunk", type=int, default=16)
     ap.add_argument("--max-seq", type=int, default=1024)
@@ -60,11 +63,12 @@ def main():
         f"dtype={dtype.__name__} quant={args.quant or '-'} "
         f"kv_quant={args.kv_quant or '-'}")
 
-    if args.quant in ("int8", "w8a8"):
+    if args.quant in ("int8", "w8a8", "int4"):
         from ai_agent_kubectl_tpu.ops.quant import random_params_int8, to_w8a8
 
         params = random_params_int8(jax.random.PRNGKey(0), cfg, dtype=dtype,
-                                    quantize_embed=True)
+                                    quantize_embed=True,
+                                    int4=(args.quant == "int4"))
         if args.quant == "w8a8":
             params = to_w8a8(params)
     else:
