@@ -374,10 +374,18 @@ class JaxEngine:
             if self.model_path:
                 from ..models.convert import convert_hf_checkpoint
 
-                logger.info("Loading checkpoint from %s", self.model_path)
+                logger.info("Loading checkpoint from %s (quant=%s)",
+                            self.model_path, self.quant or "-")
+                # Quantization happens DURING the streaming load (one
+                # layer at a time): a 7B bf16 tree (~17 GB) would OOM the
+                # chip before a post-hoc quantize could run.
                 self.params = convert_hf_checkpoint(
-                    self.model_cfg, self.model_path, dtype=self.dtype
+                    self.model_cfg, self.model_path, dtype=self.dtype,
+                    quant=self.quant,
+                    quantize_embed=self._quantize_embed,
                 )
+                if self.quant:
+                    self._quantized = True
             else:
                 logger.warning(
                     "No MODEL_PATH; random-initializing %s (toy/dev mode)",

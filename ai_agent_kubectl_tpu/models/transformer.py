@@ -32,10 +32,10 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import dense_attention
+from ..ops.attention import dense_attention, dense_attention_quant
 from ..ops.norms import rms_norm
-from ..ops.quant import (QuantKV, embed_lookup, kv_dequantize, kv_quantize,
-                         qmatmul, tied_head)
+from ..ops.quant import (QuantKV, embed_lookup, kv_quantize, qmatmul,
+                         tied_head)
 from ..ops.rope import apply_rope
 from .config import ModelConfig
 
@@ -203,11 +203,13 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
     # Write this chunk's K/V into the cache at its absolute positions.
     # (scatter; positions are per-slot absolute indices)
     if isinstance(layer_k, QuantKV):
-        # int8 KV: quantize the fresh chunk at write, dequantize the read
-        # span — the convert+scale is elementwise and fuses into the
-        # attention matmuls' operand reads, so only int8 bytes cross HBM
-        # for the context (half the decode-attention traffic, half the
-        # pool). The fresh chunk's own k/v stay bf16 for the ring path.
+        # int8 KV: quantize the fresh chunk at write; the read span stays
+        # int8 all the way into the attention dots —
+        # dense_attention_quant commutes the per-(position, head) scales
+        # onto the scores/probs, so only int8 bytes cross HBM for the
+        # context (half the decode-attention traffic, half the pool) and
+        # no dequantized copy ever materializes. The fresh chunk's own
+        # k/v stay bf16 for the ring path.
         qk, qv = kv_quantize(k), kv_quantize(v)
         layer_k = QuantKV(q=layer_k.q.at[batch_idx, positions].set(qk.q),
                           s=layer_k.s.at[batch_idx, positions].set(qk.s))
@@ -217,10 +219,28 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
             raise NotImplementedError(
                 "paged decode attention does not read int8 KV; the engine "
                 "resolves KV_QUANT=int8 to the dense KV ladder")
-        k_ctx = kv_dequantize(
-            QuantKV(layer_k.q[:, :kv_limit], layer_k.s[:, :kv_limit]), h.dtype)
-        v_ctx = kv_dequantize(
-            QuantKV(layer_v.q[:, :kv_limit], layer_v.s[:, :kv_limit]), h.dtype)
+        if attn_impl == "ring" and S > 1:
+            # Ring prefill attends over the chunk's own fresh bf16 k/v
+            # (no prior cache context); the quantized write above still
+            # lands every position for later decode.
+            from ..parallel.ring_attention import ring_attention
+
+            attn = ring_attention(q, k, v, positions, mesh)
+        else:
+            kv_pos = jnp.arange(kv_limit)[None, None, :]
+            mask = kv_pos <= positions[:, :, None]
+            attn = dense_attention_quant(
+                q,
+                layer_k.q[:, :kv_limit], layer_k.s[:, :kv_limit],
+                layer_v.q[:, :kv_limit], layer_v.s[:, :kv_limit],
+                mask,
+            )
+        h = h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
+
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
+        mlp = (_moe_mlp(cfg, lp, x, mesh, token_mask, moe_impl)
+               if cfg.is_moe else _dense_mlp(cfg, lp, x))
+        return h + mlp, layer_k, layer_v
     else:
         layer_k = layer_k.at[batch_idx, positions].set(k.astype(layer_k.dtype))
         layer_v = layer_v.at[batch_idx, positions].set(v.astype(layer_v.dtype))

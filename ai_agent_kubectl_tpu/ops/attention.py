@@ -42,6 +42,15 @@ def dense_attention(
     """softmax(q k^T / sqrt(d) [+ mask]) v with GQA head expansion.
 
     ``logit_softcap`` applies Gemma-2-style tanh capping when > 0.
+
+    The dots run in the QUERY dtype with f32 accumulation — under bf16
+    serving the MXU takes bf16 operands at full rate (upcasting K/V to
+    f32 first would both materialize a 2x-bytes copy of the whole KV
+    span per layer per step and push the dot into the ~4x-slower f32 MXU
+    mode — measured ~16 ms of a 34 ms 7B bs=48 decode step before r5);
+    under the f32 test configs everything stays f32, preserving the
+    reference numerics the kernels are validated against. Softmax and
+    masking stay f32 always.
     """
     n_heads = q.shape[2]
     n_kv = k.shape[2]
@@ -51,15 +60,70 @@ def dense_attention(
         scale = q.shape[-1] ** -0.5
 
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        "bqhd,bkhd->bhqk", q, k.astype(q.dtype),
+        preferred_element_type=jnp.float32,
     ) * scale
     if logit_softcap > 0.0:
         logits = jnp.tanh(logits / logit_softcap) * logit_softcap
     if mask is not None:
         logits = jnp.where(mask[:, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype),
+                     v.astype(q.dtype),
+                     preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
+
+
+def dense_attention_quant(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,        # int8 [b, s, n_kv, d] payload
+    k_s: jnp.ndarray,        # f32  [b, s, n_kv] scales
+    v_q: jnp.ndarray,
+    v_s: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    *,
+    scale: Optional[float] = None,
+    logit_softcap: float = 0.0,
+) -> jnp.ndarray:
+    """Dense attention reading an int8-quantized KV span DIRECTLY.
+
+    The per-(position, head) dequant scale commutes out of the head_dim
+    contraction: ``q . (K_q[s] * k_s[s]) == (q . K_q[s]) * k_s[s]``, so
+    the K scale multiplies the [.., q, s] SCORES and the V scale folds
+    into the softmax PROBS — both [s]-shaped surfaces, 1/head_dim the
+    work of dequantizing the span — and the int8 payloads feed the MXU
+    dots via the fusable in-dot convert. Before r5 the serving path
+    dequantized the whole span to bf16 per layer per step
+    (models/transformer.py kv_dequantize), which XLA materialized:
+    ~13 GB of extra HBM traffic per 7B bs=48 step — the single largest
+    cost in the decode step (device-profiled ablation, PROFILE.md r5).
+
+    GQA is handled by grouping query heads ([b, q, n_kv, g, d]) instead
+    of materializing repeated int8 KV.
+    """
+    B, Q, H, D = q.shape
+    KV = k_q.shape[2]
+    G = H // KV
+    if scale is None:
+        scale = D ** -0.5
+    qg = q.reshape(B, Q, KV, G, D)
+    # [b, kv, g, q, s] logits; K int8 -> q.dtype converts inside the dot.
+    logits = jnp.einsum(
+        "bqkgd,bskd->bkgqs", qg, k_q.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    logits = logits * (k_s.transpose(0, 2, 1)[:, :, None, None, :] * scale)
+    if logit_softcap > 0.0:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = probs * v_s.transpose(0, 2, 1)[:, :, None, None, :]
+    out = jnp.einsum(
+        "bkgqs,bskd->bqkgd", probs.astype(q.dtype), v_q.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, Q, H, D).astype(q.dtype)
 
 
 def causal_mask(q_len: int, kv_len: int, q_offset: jnp.ndarray | int = 0) -> jnp.ndarray:

@@ -118,3 +118,43 @@ def test_conversion_shape_mismatch_rejected(tmp_path):
     )
     with pytest.raises(ValueError, match="mismatch"):
         convert_hf_checkpoint(bad_cfg, tmp_path, dtype=jnp.float32)
+
+
+def test_streaming_quantized_conversion_matches_posthoc(tmp_path):
+    """convert_hf_checkpoint(quant=...) — the layer-at-a-time quantizing
+    load that lets a 7B checkpoint fit a 16 GB chip (VERDICT r4 item 7) —
+    must produce the EXACT tree quantize_params_int8/int4(convert(...))
+    would: same structure, same payloads, same scales."""
+    import jax
+
+    from ai_agent_kubectl_tpu.ops.quant import quantize_params_int8
+    from ai_agent_kubectl_tpu.ops.quant4 import quantize_params_int4
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    cfg = ModelConfig(
+        name="tiny-llama", vocab_size=128, dim=64, n_layers=3, n_heads=4,
+        n_kv_heads=2, head_dim=16, mlp_hidden=176, rope_theta=10000.0,
+        rms_eps=1e-5,
+    )
+    full = convert_hf_checkpoint(cfg, tmp_path, dtype=jnp.float32)
+    for quant, posthoc in (("int8", quantize_params_int8),
+                           ("int4", quantize_params_int4)):
+        streamed = convert_hf_checkpoint(
+            cfg, tmp_path, dtype=jnp.float32, quant=quant,
+            quantize_embed=True)
+        expect = posthoc(full, quantize_embed=True)
+        fs = jax.tree_util.tree_flatten_with_path(streamed)[0]
+        fe = jax.tree_util.tree_flatten_with_path(expect)[0]
+        assert len(fs) == len(fe)
+        for (ps, ls), (pe, le) in zip(fs, fe):
+            assert ps == pe, (quant, ps, pe)
+            np.testing.assert_array_equal(np.asarray(ls), np.asarray(le),
+                                          err_msg=f"{quant} {ps}")
