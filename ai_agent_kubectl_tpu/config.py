@@ -157,6 +157,12 @@ class ServiceConfig:
     chunk_pipe_depth: int = 2               # CHUNK_PIPE_DEPTH
     prefill_buckets: str = "64,128,256,512,1024"  # PREFILL_BUCKETS (padded prefill shapes)
     temperature: float = 0.0                # TEMPERATURE (0 == greedy, matches app.py:109)
+    # Sampling filters (apply when TEMPERATURE > 0): TOP_K keeps the k
+    # highest logits (0 disables); TOP_P nucleus sampling (1.0 disables).
+    # Static service config — both engines sample from the same filtered
+    # distribution at the same settings (engine/sampling.py).
+    top_k: int = 0                          # TOP_K
+    top_p: float = 1.0                      # TOP_P
     attn_impl: str = "auto"                 # ATTN_IMPL: auto | dense | flash (prefill kernel)
     # Decode attention: "paged" reads only each slot's live KV pages
     # (ops/paged_attention.py). "auto" picks paged for GQA models on TPU
@@ -241,6 +247,8 @@ class ServiceConfig:
             chunk_pipe_depth=_env_int("CHUNK_PIPE_DEPTH", 2),
             prefill_buckets=_env_str("PREFILL_BUCKETS", "64,128,256,512,1024"),
             temperature=_env_float("TEMPERATURE", 0.0),
+            top_k=_env_int("TOP_K", 0),
+            top_p=_env_float("TOP_P", 1.0),
             attn_impl=(_env_str("ATTN_IMPL", "auto") or "auto").lower(),
             decode_attn=(_env_str("DECODE_ATTN", "auto") or "auto").lower(),
             moe_impl=(_env_str("MOE_IMPL", "auto") or "auto").lower(),

@@ -189,6 +189,8 @@ class BatchedJaxEngine(JaxEngine):
             kv_quant=cfg.kv_quant,
             max_seq_len=cfg.max_seq_len,
             prefill_buckets=cfg.prefill_bucket_list,
+            top_k=cfg.top_k,
+            top_p=cfg.top_p,
             attn_impl=cfg.attn_impl,
             moe_impl=cfg.moe_impl,
             prefix_cache=cfg.hbm_prefix_cache,
@@ -308,7 +310,9 @@ class BatchedJaxEngine(JaxEngine):
                                         token_mask=active[:, None],
                                         page_size=self.kv_page_size)
                 key, sub = jax.random.split(key)
-                nxt = sample_tokens_batched(logits[:, 0], sub, temps)
+                nxt = sample_tokens_batched(logits[:, 0], sub, temps,
+                                            top_k=self.top_k,
+                                            top_p=self.top_p)
                 nxt = jnp.where(active, nxt, tok[:, 0])
                 pos = pos + active.astype(jnp.int32)[:, None]
                 return (nxt[:, None], pos, cache, key), nxt
@@ -804,7 +808,10 @@ class BatchedJaxEngine(JaxEngine):
                                         moe_impl=self.moe_impl,
                                         token_mask=mask,
                                         logits_at=lengths - 1)
-                first = sample_tokens_batched(logits[:, 0], key, temperatures)
+                first = sample_tokens_batched(logits[:, 0], key,
+                                              temperatures,
+                                              top_k=self.top_k,
+                                              top_p=self.top_p)
                 return first, cache
 
             fn = jax.jit(batch_suffix, donate_argnums=(3,))

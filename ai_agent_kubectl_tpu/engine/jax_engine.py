@@ -84,6 +84,8 @@ class JaxEngine:
         kv_quant: str = "",
         max_seq_len: int = 1024,
         prefill_buckets: tuple = (64, 128, 256, 512, 1024),
+        top_k: int = 0,
+        top_p: float = 1.0,
         attn_impl: str = "auto",
         moe_impl: str = "auto",
         prefix_cache: bool = True,
@@ -151,7 +153,17 @@ class JaxEngine:
         # a chunk can reach (kv_bucket_ladder; batcher has its own ladder
         # topped by S_alloc).
         self._kv_buckets = kv_bucket_ladder(self.max_seq_len)
-        self._sample_fn = jax.jit(sample_token_traced)
+        # top-k / top-p are STATIC service config (changing them
+        # recompiles — the right trade; engine/sampling.py) applied
+        # identically by this engine and the batched scheduler.
+        if top_k < 0 or not (0.0 < top_p <= 1.0):
+            raise ValueError(
+                f"TOP_K must be >= 0 and TOP_P in (0, 1], got "
+                f"{top_k}/{top_p}")
+        self.top_k = top_k
+        self.top_p = top_p
+        self._sample_fn = jax.jit(partial(
+            sample_token_traced, top_k=top_k, top_p=top_p))
         self._prefix = None            # PrefixKV once built
         self._splice_prefix_fn = None
 
@@ -173,6 +185,8 @@ class JaxEngine:
             kv_quant=cfg.kv_quant,
             max_seq_len=cfg.max_seq_len,
             prefill_buckets=cfg.prefill_bucket_list,
+            top_k=cfg.top_k,
+            top_p=cfg.top_p,
             attn_impl=cfg.attn_impl,
             moe_impl=cfg.moe_impl,
             prefix_cache=cfg.hbm_prefix_cache,
@@ -765,7 +779,10 @@ class JaxEngine:
                                             attn_impl="dense", mesh=self.mesh,
                                             moe_impl=self.moe_impl)
                     key, sub = jax.random.split(key)
-                    nxt = sample_token_traced(logits[:, 0], sub, temperature)
+                    nxt = sample_token_traced(logits[:, 0], sub,
+                                              temperature,
+                                              top_k=self.top_k,
+                                              top_p=self.top_p)
                     return (nxt[:, None], pos + 1, cache, key), nxt
 
                 (tok, pos, cache, key), toks = jax.lax.scan(
