@@ -87,31 +87,37 @@ def test_compiled_paged_matches_dense_decode():
             np.asarray(ref).astype(np.float32), rtol=3e-2, atol=3e-2)
 
 
-def test_int8_kv_dequant_fuses_into_decode_attention():
-    """KV_QUANT=int8's whole decode-bandwidth claim (ops/quant.py) rests
-    on XLA fusing the int8→f32→bf16 convert+scale into the attention
-    matmuls' context reads. Compile a decode-shaped attention over a
-    dequantized context and assert no ENTRY-level instruction materializes
-    a full-context bf16/f32 tensor."""
+def test_quant_attention_reads_int8_kv_without_materializing():
+    """The r5 serving contract for KV_QUANT=int8
+    (ops/attention.py::dense_attention_quant): the int8 payload feeds the
+    attention dots directly — scales commute onto scores/probs — so no
+    ENTRY-level instruction may materialize a full-precision copy of the
+    context, and the outputs must match dequantize-then-attend."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
-    from ai_agent_kubectl_tpu.ops.attention import dense_attention
-    from ai_agent_kubectl_tpu.ops.quant import QuantKV, kv_dequantize, kv_quantize
+    from ai_agent_kubectl_tpu.ops.attention import (dense_attention,
+                                                    dense_attention_quant)
+    from ai_agent_kubectl_tpu.ops.quant import kv_dequantize, kv_quantize
 
     B, S, KV, hd, H = 48, 192, 16, 256, 16
     k = kv_quantize(_rand((B, S, KV, hd), 10, jnp.float32))
     v = kv_quantize(_rand((B, S, KV, hd), 11, jnp.float32))
     q = _rand((B, 1, H, hd), 12, jnp.bfloat16)
     positions = jnp.full((B, 1), S - 1, jnp.int32)
+    mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]
 
-    def decode_attn(q, k, v, positions):
-        k_ctx = kv_dequantize(k, q.dtype)
-        v_ctx = kv_dequantize(v, q.dtype)
-        mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]
-        return dense_attention(q, k_ctx, v_ctx, mask)
+    fn = jax.jit(lambda q, kq, ks, vq, vs, m:
+                 dense_attention_quant(q, kq, ks, vq, vs, m))
+    out = fn(q, k.q, k.s, v.q, v.s, mask)
+    ref = dense_attention(q, kv_dequantize(k, q.dtype),
+                          kv_dequantize(v, q.dtype), mask)
+    np.testing.assert_allclose(
+        np.asarray(out).astype(np.float32),
+        np.asarray(ref).astype(np.float32), rtol=3e-2, atol=3e-2)
 
-    hlo = jax.jit(decode_attn).lower(q, k, v, positions).compile().as_text()
+    hlo = fn.lower(q, k.q, k.s, v.q, v.s, mask).compile().as_text()
     entry = hlo.split("ENTRY")[-1]
     materialized = [
         line.strip() for line in entry.splitlines()
@@ -120,9 +126,30 @@ def test_int8_kv_dequant_fuses_into_decode_attention():
         and "parameter" not in line
     ]
     assert not materialized, (
-        "int8 KV dequant materialized a full-precision context copy:\n"
+        "quant attention materialized a full-precision context copy:\n"
         + "\n".join(materialized)
     )
+
+
+def test_compiled_int4_kernel_matches_xla_fallback():
+    """The compiled packed-nibble Pallas matmul (ops/quant4.py) must
+    compute the XLA fallback's group-wise math on the chip — the parity
+    that licenses QUANT=int4 as a served feature."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ai_agent_kubectl_tpu.ops.quant4 import (_xla_int4_matmul,
+                                                 qmatmul4, quantize_int4)
+
+    w = _rand((1024, 512), 20, jnp.float32) * 0.05
+    x = _rand((48, 1024), 21, jnp.bfloat16)
+    qw = quantize_int4(jnp.asarray(w))
+    out = jax.jit(qmatmul4)(x, qw)          # compiled Pallas on TPU
+    ref = _xla_int4_matmul(x, qw)
+    np.testing.assert_allclose(
+        np.asarray(out).astype(np.float32),
+        np.asarray(ref).astype(np.float32), rtol=2e-2, atol=2e-2)
 
 
 def test_int8_convert_fuses_into_weight_read():
