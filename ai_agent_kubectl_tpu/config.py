@@ -180,6 +180,31 @@ class ServiceConfig:
     # long while work is in flight (hung device dispatch), the engine is
     # marked degraded and every waiting request is failed. 0 disables.
     engine_watchdog_secs: float = 120.0     # ENGINE_WATCHDOG_SECS
+
+    # --- overload protection / failure containment ---
+    # Bounded admission: the batcher sheds work with a fast 503 +
+    # Retry-After once this many requests are queued for a decode slot,
+    # instead of queueing doomed work until it 504s at llm_timeout.
+    # 0 = unbounded (the pre-containment behaviour). Enforced by the
+    # continuous-batching engine (the default); single-sequence jax /
+    # fake / openai deployments rely on MAX_INFLIGHT_REQUESTS instead.
+    max_queue_depth: int = 64               # MAX_QUEUE_DEPTH
+    # HTTP-layer cap on concurrently-processing generation requests
+    # (/kubectl-command + /kubectl-command/stream); excess sheds with a
+    # fast 503 + Retry-After before touching the engine. 0 = unlimited.
+    max_inflight_requests: int = 256        # MAX_INFLIGHT_REQUESTS
+    # Serve rule-based FallbackEngine responses (degraded: true, HTTP 200)
+    # instead of 503 while the circuit breaker is open / the engine fails.
+    degraded_fallback: bool = False         # DEGRADED_FALLBACK
+    # Circuit breaker around the engine: opens after this many engine
+    # failures within breaker_window_secs (0 disables); after
+    # breaker_recovery_secs one half-open probe re-closes it on success.
+    breaker_threshold: int = 5              # BREAKER_THRESHOLD
+    breaker_window_secs: float = 30.0       # BREAKER_WINDOW_SECS
+    breaker_recovery_secs: float = 15.0     # BREAKER_RECOVERY_SECS
+    # Fault-injection harness (testing/faults.py):
+    # "admit:error:0.5,chunk:hang,generate:delay:2.0". Empty disables.
+    fault_points: str = ""                  # FAULT_POINTS
     # Graceful shutdown: stop accepting new requests, wait up to this long
     # for in-flight generations to finish, then abort what remains.
     drain_timeout_secs: float = 10.0        # DRAIN_TIMEOUT_SECS
@@ -255,6 +280,13 @@ class ServiceConfig:
             kv_page_size=_env_int("KV_PAGE_SIZE", 16),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
             engine_watchdog_secs=_env_float("ENGINE_WATCHDOG_SECS", 120.0),
+            max_queue_depth=_env_int("MAX_QUEUE_DEPTH", 64),
+            max_inflight_requests=_env_int("MAX_INFLIGHT_REQUESTS", 256),
+            degraded_fallback=_env_bool("DEGRADED_FALLBACK", False),
+            breaker_threshold=_env_int("BREAKER_THRESHOLD", 5),
+            breaker_window_secs=_env_float("BREAKER_WINDOW_SECS", 30.0),
+            breaker_recovery_secs=_env_float("BREAKER_RECOVERY_SECS", 15.0),
+            fault_points=_env_str("FAULT_POINTS", "") or "",
             drain_timeout_secs=_env_float("DRAIN_TIMEOUT_SECS", 10.0),
             compile_cache_dir=os.getenv(
                 "COMPILE_CACHE_DIR", "~/.cache/ai-agent-kubectl-tpu/xla-cache"

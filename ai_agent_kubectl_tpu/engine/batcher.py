@@ -32,6 +32,7 @@ to it through a thread-safe admission queue and per-request asyncio queues
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
 import logging
 import queue as _queue
@@ -48,7 +49,8 @@ from ..models.transformer import KVCache, forward
 from ..ops.quant import (kv_broadcast_rows, kv_set_slots, kv_slot_update,
                          kv_tokens, kv_update_slice)
 from .jax_engine import JaxEngine
-from .protocol import EngineResult, EngineUnavailable, GenerationTimeout
+from .protocol import (EngineOverloaded, EngineResult, EngineUnavailable,
+                       GenerationTimeout)
 from .sampling import sample_tokens_batched
 from .tokenizer import StreamDecoder
 
@@ -137,6 +139,8 @@ class BatchedJaxEngine(JaxEngine):
                  kv_page_size: int = 16, decode_attn: str = "auto",
                  watchdog_secs: float = 120.0,
                  chunk_pipe_depth: int = 2,
+                 max_queue_depth: int = 64,
+                 faults=None,
                  **kwargs):
         super().__init__(*args, **kwargs)
         if batch_size < 1:
@@ -163,6 +167,18 @@ class BatchedJaxEngine(JaxEngine):
         self.kv_page_size = max(1, kv_page_size)
         self.decode_attn = decode_attn
         self.watchdog_secs = watchdog_secs
+        # Bounded admission (overload shedding): submissions beyond this
+        # queue depth raise EngineOverloaded at submit time instead of
+        # waiting llm_timeout for a slot that cannot come. 0 = unbounded.
+        self.max_queue_depth = max(0, max_queue_depth)
+        #: testing/faults.py injector (admit / chunk points); None in
+        #: normal serving.
+        self.faults = faults
+        self._rejections = 0       # EngineOverloaded sheds (stats())
+        # Completion timestamps feeding the live drain-rate estimate that
+        # prices Retry-After on sheds. Appended from the scheduler thread,
+        # read racily from the event loop — fine for a hint.
+        self._finish_times: collections.deque = collections.deque(maxlen=64)
         self._admissions: _queue.Queue = _queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._running = False
@@ -177,9 +193,23 @@ class BatchedJaxEngine(JaxEngine):
                                    # seconds on the scheduler thread)
 
     @classmethod
-    def from_config(cls, cfg) -> "BatchedJaxEngine":
+    def from_config(cls, cfg, faults=None) -> "BatchedJaxEngine":
+        """``faults=None`` parses FAULT_POINTS itself (standalone use);
+        the factory passes its single shared injector instead so admit/
+        chunk/generate points live on one object."""
         from ..models.config import get_config
+        from ..testing.faults import FaultInjector
 
+        if faults is None:
+            faults = FaultInjector.from_spec(cfg.fault_points)
+            if faults is not None and faults.has("generate"):
+                # Standalone from_config can't install the ChaosEngine
+                # wrapper the generate point needs — refuse rather than
+                # run a drill that silently does less than its spec.
+                raise ValueError(
+                    "FAULT_POINTS 'generate' requires the ChaosEngine "
+                    "wrapper; build via server.factory.build_engine"
+                )
         return cls(
             get_config(cfg.model_name),
             model_path=cfg.model_path,
@@ -203,6 +233,8 @@ class BatchedJaxEngine(JaxEngine):
             kv_page_size=cfg.kv_page_size,
             decode_attn=cfg.decode_attn,
             watchdog_secs=cfg.engine_watchdog_secs,
+            max_queue_depth=cfg.max_queue_depth,
+            faults=faults,
         )
 
     # ------------------------------------------------------------ startup
@@ -547,7 +579,29 @@ class BatchedJaxEngine(JaxEngine):
             "queue_depth": self._admissions.qsize(),
             "kv_pages_used": used,
             "kv_pages_total": self.batch_size * pages_per_slot,
+            "queue_rejections": self._rejections,
+            "max_queue_depth": self.max_queue_depth,
         }
+
+    #: finish timestamps older than this don't feed the drain-rate
+    #: estimate — after an idle hour the first shed must not price
+    #: Retry-After off a rate diluted by the gap.
+    DRAIN_RATE_HORIZON_SECS = 60.0
+
+    def retry_after_hint(self, extra_depth: int = 0) -> float:
+        """Seconds until queued work plausibly drains, from the live
+        completion rate over recent finishes (last ≤64, within the
+        freshness horizon) — the Retry-After a shed response carries.
+        Falls back to 5 s with no recent drain history (cold or
+        just-woken engine), clamped to [1, 60]."""
+        depth = self._admissions.qsize() + extra_depth
+        horizon = time.monotonic() - self.DRAIN_RATE_HORIZON_SECS
+        ts = [t for t in list(self._finish_times) if t >= horizon]
+        if len(ts) >= 2 and ts[-1] > ts[0]:
+            rate = (len(ts) - 1) / (ts[-1] - ts[0])
+            if rate > 0:
+                return min(max(depth / rate, 1.0), 60.0)
+        return 5.0
 
     # ---------------------------------------------------------- scheduler
 
@@ -629,14 +683,18 @@ class BatchedJaxEngine(JaxEngine):
                 if self._inflight:
                     self._consume_oldest()
                     continue
-                # Idle: block until an admission arrives.
+                # Idle: block until an admission arrives. Routed through
+                # _admit_popped so a failing admission (e.g. an injected
+                # admit fault or a scratch-cache OOM) errors THAT request
+                # instead of tripping the scheduler-error path that fails
+                # every active slot.
                 try:
                     req = self._admissions.get(timeout=0.05)
                 except _queue.Empty:
                     continue
                 self._admitting += 1
                 try:
-                    self._admit_one(req)
+                    self._admit_popped([req])
                 finally:
                     self._admitting -= 1
             except Exception:  # pragma: no cover - scheduler must survive
@@ -846,6 +904,8 @@ class BatchedJaxEngine(JaxEngine):
         scatter the rows into their slots — zero host reads; the first
         tokens travel as one ("firsts", vector) pipeline entry (one fetch
         for the whole group)."""
+        if self.faults is not None:
+            self.faults.check("admit")
         live = []
         for req in reqs:
             if req.cancel.is_set():
@@ -937,6 +997,8 @@ class BatchedJaxEngine(JaxEngine):
         reads. The first token reaches the client through the inflight
         pipeline (``_consume_first``), overlapping its transfer with decode
         chunks instead of stalling every active slot on a round trip."""
+        if self.faults is not None:
+            self.faults.check("admit")
         if req.cancel.is_set():
             return
         if req.deadline is not None and time.monotonic() > req.deadline:
@@ -1026,6 +1088,10 @@ class BatchedJaxEngine(JaxEngine):
                     self._finish(i, "length")
 
     def _dispatch_chunk(self) -> None:
+        if self.faults is not None:
+            # A "chunk" hang blocks this (scheduler) thread exactly like a
+            # hung device dispatch — the watchdog's target scenario.
+            self.faults.check("chunk")
         active_slots = [s for s in self._slots
                         if s is not None and not s.exhausted]
         if not active_slots:
@@ -1185,6 +1251,9 @@ class BatchedJaxEngine(JaxEngine):
         self._slots[slot_idx] = None
         if slot is None:  # pragma: no cover - defensive
             return
+        # Any finish frees a slot — errors included — so all of them feed
+        # the drain-rate estimate behind retry_after_hint().
+        self._finish_times.append(time.monotonic())
         if error is not None:
             self._emit(slot.req, "error", error)
             return
@@ -1222,6 +1291,17 @@ class BatchedJaxEngine(JaxEngine):
                              temperature: float, timeout: Optional[float]):
         if not self._ready:
             raise EngineUnavailable("engine not started")
+        # Load shedding at submit time: beyond max_queue_depth every queued
+        # request would wait multiple full batches for a slot — reject in
+        # microseconds with a drain-rate-priced Retry-After rather than
+        # holding the connection until the 504 at llm_timeout.
+        depth = self._admissions.qsize()
+        if self.max_queue_depth and depth >= self.max_queue_depth:
+            self._rejections += 1
+            raise EngineOverloaded(
+                f"admission queue full ({depth}/{self.max_queue_depth})",
+                retry_after=self.retry_after_hint(),
+            )
         t_submit = time.monotonic()
         deadline = (t_submit + timeout) if timeout else None
         max_tokens = max(1, min(max_tokens, self.max_seq_len - 1))

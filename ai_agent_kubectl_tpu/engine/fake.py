@@ -8,25 +8,10 @@ status code without a TPU or network.
 from __future__ import annotations
 
 import asyncio
-import re
 from typing import AsyncIterator, Dict, List, Optional
 
+from .fallback import extract_query, rule_command  # rules promoted there
 from .protocol import EngineResult, EngineUnavailable, GenerationTimeout
-
-_RULES = [
-    (re.compile(r"\b(list|get|show)\b.*\bpods?\b", re.I), "kubectl get pods"),
-    (re.compile(r"\b(list|get|show)\b.*\bnodes?\b", re.I), "kubectl get nodes"),
-    (re.compile(r"\b(list|get|show)\b.*\b(deployments?|deploys?)\b", re.I),
-     "kubectl get deployments"),
-    (re.compile(r"\b(list|get|show)\b.*\bservices?\b", re.I), "kubectl get services"),
-    (re.compile(r"\b(list|get|show)\b.*\bnamespaces?\b", re.I), "kubectl get namespaces"),
-    (re.compile(r"\blogs?\b.*?(?:\bof\b|\bfor\b|\bfrom\b)\s+(\S+)", re.I),
-     "kubectl logs {0}"),
-    (re.compile(r"\bdescribe\b.*\bpod\b\s+(\S+)", re.I), "kubectl describe pod {0}"),
-    (re.compile(r"\bdelete\b.*\bpod\b\s+(\S+)", re.I), "kubectl delete pod {0}"),
-    (re.compile(r"\bscale\b.*\bdeployment\b\s+(\S+).*?\b(\d+)\b", re.I),
-     "kubectl scale deployment {0} --replicas={1}"),
-]
 
 
 class FakeEngine:
@@ -59,14 +44,7 @@ class FakeEngine:
         self._ready = False
 
     def _answer(self, prompt: str) -> str:
-        # The service renders prompts as "...User Request: <query>\nKubectl Command:"
-        m = re.search(r"User Request:\s*(.*?)\s*(?:\nKubectl Command:|\Z)", prompt, re.S)
-        query = m.group(1) if m else prompt
-        for pattern, template in _RULES:
-            hit = pattern.search(query)
-            if hit:
-                return template.format(*hit.groups())
-        return "kubectl get all"
+        return rule_command(extract_query(prompt))
 
     async def generate(
         self,

@@ -15,6 +15,18 @@ class EngineUnavailable(RuntimeError):
     (reference app.py:179-180)."""
 
 
+class EngineOverloaded(EngineUnavailable):
+    """Admission rejected by overload protection (bounded queue / inflight
+    cap) → fast HTTP 503 with ``Retry-After``. Raised at submit time so a
+    doomed request is shed in microseconds instead of queueing until it
+    times out at 504. ``retry_after`` is the engine's estimate (seconds)
+    of when capacity frees, computed from the live queue drain rate."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = max(0.0, float(retry_after))
+
+
 class GenerationTimeout(TimeoutError):
     """Generation exceeded the configured timeout → HTTP 504
     (reference app.py:189-191)."""

@@ -32,13 +32,16 @@ from aiohttp import web
 from pydantic import ValidationError
 
 from ..config import ServiceConfig
-from ..engine.protocol import Engine, EngineResult, EngineUnavailable, GenerationTimeout
+from ..engine.fallback import FallbackEngine
+from ..engine.protocol import (Engine, EngineOverloaded, EngineResult,
+                               EngineUnavailable, GenerationTimeout)
 from ..engine.prompts import render_prompt
+from .breaker import STATE_CODES, CircuitBreaker
 from .cache import CachedSingleFlight
 from .executor import CommandExecutor, build_metadata, utcnow_iso
 from .metrics import Metrics
 from .output_parser import UnsafeCommandError, parse_llm_output
-from .ratelimit import SlidingWindowLimiter
+from .ratelimit import SlidingWindowLimiter, ceil_seconds
 from .sanitize import sanitize_query
 from .schemas import (
     CommandResponse,
@@ -53,6 +56,13 @@ logger = logging.getLogger(__name__)
 
 RATE_LIMITED_ROUTES = {"/kubectl-command", "/kubectl-command/stream", "/execute"}
 AUTH_ROUTES = RATE_LIMITED_ROUTES | {"/debug/trace"}
+#: routes the MAX_INFLIGHT_REQUESTS overload gate covers (the ones that
+#: occupy the engine).
+GENERATE_ROUTES = {"/kubectl-command", "/kubectl-command/stream"}
+
+
+def _retry_after_header(seconds: float) -> dict:
+    return {"Retry-After": str(max(1, ceil_seconds(seconds)))}
 
 
 def _client_key(request: web.Request) -> str:
@@ -86,19 +96,113 @@ class Service:
             cfg.cache_maxsize, cfg.cache_ttl
         )
         self.limiter = SlidingWindowLimiter(cfg.rate_limit_count, cfg.rate_limit_window)
+        # Failure containment: a rolling-window breaker around every engine
+        # call, an optional rule-based degradation path behind it, and the
+        # HTTP-layer inflight counter the overload middleware maintains.
+        self.breaker = CircuitBreaker(
+            threshold=cfg.breaker_threshold,
+            window_secs=cfg.breaker_window_secs,
+            recovery_secs=cfg.breaker_recovery_secs,
+        )
+        self.fallback: Optional[FallbackEngine] = (
+            FallbackEngine() if cfg.degraded_fallback else None
+        )
+        self.inflight_requests = 0
 
-    async def generate_command(self, sanitized_query: str) -> tuple[str, bool, Optional[EngineResult]]:
-        """Cache-or-generate; returns (command, from_cache, engine_result)."""
+    def retry_after_hint(self) -> float:
+        """Retry-After for HTTP-layer sheds: the engine's drain-rate
+        estimate when it has one, else a flat second."""
+        fn = getattr(self.engine, "retry_after_hint", None)
+        if callable(fn):
+            try:
+                return float(fn())
+            except Exception:  # pragma: no cover - defensive
+                pass
+        return 1.0
+
+    async def run_engine(self, coro_fn):
+        """One engine call under the circuit breaker: fail fast while the
+        breaker is open (a half-open probe is the exception), count every
+        engine failure, close on success. Overload sheds pass through
+        untouched — a full queue is backpressure, not engine brokenness."""
+        token = self.breaker.begin()
+        if token is None:
+            raise EngineUnavailable(
+                f"circuit breaker {self.breaker.state}: engine calls "
+                "suspended until a half-open probe succeeds"
+            )
+        # Every exit must either record an outcome or release the probe
+        # slot: an overload shed or a client-cancelled call (CancelledError
+        # is a BaseException) says nothing about engine health, but if it
+        # was the half-open probe, leaving _probe_inflight set would wedge
+        # the breaker half-open forever. The token fences stragglers: a
+        # call outliving an open transition reports into a dead epoch.
+        # Readiness is sampled BEFORE the call: "engine not started"
+        # rejections during a restart's warm-up must not open the breaker
+        # (it would extend the outage past the model load by up to
+        # recovery_secs), while a watchdog trip mid-call — which drops
+        # ready AFTER the call began — still counts as the engine failure
+        # it is.
+        was_ready = bool(getattr(self.engine, "ready", True))
+        decided = False
+        try:
+            result = await coro_fn()
+        except EngineOverloaded:
+            # Counted here — once per actual engine shed — rather than in
+            # the handlers, where every coalesced single-flight waiter
+            # re-raising the shared exception would inflate the counter.
+            self.metrics.queue_rejections.labels("engine").inc()
+            raise
+        except Exception:
+            decided = True
+            if was_ready:
+                self.breaker.record_failure(token)
+            else:
+                self.breaker.release_probe(token)
+            raise
+        else:
+            decided = True
+            self.breaker.record_success(token)
+            return result
+        finally:
+            if not decided:
+                self.breaker.release_probe(token)
+
+    async def degraded_command(self, sanitized_query: str,
+                               cause: BaseException) -> tuple[str, EngineResult]:
+        """Serve the query from the rule-based FallbackEngine (degraded
+        path). Never touches the response cache: a rule-table answer must
+        not shadow a real generation after recovery."""
+        logger.warning(
+            "Serving degraded fallback for query '%s' (breaker=%s): %s",
+            sanitized_query, self.breaker.state, cause,
+        )
+        result = await self.fallback.generate(render_prompt(sanitized_query))
+        command = parse_llm_output(result.text)
+        self.metrics.degraded_responses.inc()
+        # The request DID consult the response cache and miss before the
+        # engine failure; count it so hit+miss keeps reconciling with
+        # request totals during the outage window.
+        self.metrics.cache_misses.inc()
+        return command, result
+
+    async def generate_command(
+        self, sanitized_query: str
+    ) -> tuple[str, bool, Optional[EngineResult], bool]:
+        """Cache-or-generate; returns (command, from_cache, engine_result,
+        degraded). Engine failures (including breaker-open fast-fails)
+        degrade to rule-based responses when DEGRADED_FALLBACK is set;
+        overload sheds and unsafe outputs always propagate."""
         last_result: list[Optional[EngineResult]] = [None]
 
         async def supplier() -> str:
             prompt = render_prompt(sanitized_query)
-            result = await self.engine.generate(
+            result = await self.run_engine(lambda: self.engine.generate(
                 prompt,
                 max_tokens=self.cfg.max_new_tokens,
                 temperature=self.cfg.temperature,
                 timeout=self.cfg.llm_timeout,
-            )
+            ))
             last_result[0] = result
             command = parse_llm_output(result.text)
             logger.info(
@@ -106,19 +210,39 @@ class Service:
             )
             return command
 
-        command, from_cache = await self.cache.get_or_create(sanitized_query, supplier)
+        try:
+            command, from_cache = await self.cache.get_or_create(
+                sanitized_query, supplier
+            )
+        except EngineOverloaded:
+            raise
+        except (EngineUnavailable, GenerationTimeout, asyncio.TimeoutError) as e:
+            # Engine-path failure (unavailable / watchdog trip / timeout /
+            # open breaker): the degradation target. Anything else — an
+            # UnsafeCommandError (422) or a genuine programming bug (500)
+            # — propagates; masking a bug as a 200 degraded answer would
+            # keep it out of error rates forever (and the stream path
+            # already scopes degradation to exactly these exceptions).
+            if self.fallback is None:
+                raise
+            command, result = await self.degraded_command(sanitized_query, e)
+            return command, False, result, True
         if from_cache:
             self.metrics.cache_hits.inc()
         else:
             self.metrics.cache_misses.inc()
-        return command, from_cache, last_result[0]
+        return command, from_cache, last_result[0], False
 
 
 @web.middleware
 async def observability_middleware(request: web.Request, handler):
     svc: Service = request.app["service"]
     start = time.monotonic()
-    path = request.path
+    # Label by the matched route's canonical path, never the raw request
+    # path: a scanner walking random 404 URLs would otherwise mint a new
+    # Prometheus series per URL and grow /metrics without bound.
+    resource = getattr(request.match_info.route, "resource", None)
+    path = resource.canonical if resource is not None else "unmatched"
     status = 500
     try:
         response = await handler(request)
@@ -131,6 +255,33 @@ async def observability_middleware(request: web.Request, handler):
         elapsed = time.monotonic() - start
         svc.metrics.http_requests.labels(request.method, path, str(status)).inc()
         svc.metrics.http_latency.labels(request.method, path).observe(elapsed)
+
+
+@web.middleware
+async def overload_middleware(request: web.Request, handler):
+    """HTTP-layer load shedding (MAX_INFLIGHT_REQUESTS): generation routes
+    beyond the inflight cap get a fast 503 + Retry-After before any work
+    is done — the server stays responsive under a flood instead of
+    accumulating handlers that all time out."""
+    svc: Service = request.app["service"]
+    # <= 0 means unlimited (an operator's -1 must not shed everything).
+    cap = svc.cfg.max_inflight_requests
+    if cap <= 0 or request.path not in GENERATE_ROUTES:
+        return await handler(request)
+    if svc.inflight_requests >= cap:
+        svc.metrics.queue_rejections.labels("http").inc()
+        retry = svc.retry_after_hint()
+        return _json_error(
+            503,
+            f"Server overloaded: {svc.inflight_requests} generation "
+            f"requests in flight (cap {cap})",
+            headers=_retry_after_header(retry),
+        )
+    svc.inflight_requests += 1
+    try:
+        return await handler(request)
+    finally:
+        svc.inflight_requests -= 1
 
 
 @web.middleware
@@ -180,7 +331,12 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
         return _json_error(400, "Invalid input query: too short after sanitation")
 
     try:
-        command, from_cache, engine_result = await svc.generate_command(sanitized_query)
+        command, from_cache, engine_result, degraded = await svc.generate_command(
+            sanitized_query
+        )
+    except EngineOverloaded as e:
+        return _json_error(503, f"Server overloaded: {e}",
+                           headers=_retry_after_header(e.retry_after))
     except EngineUnavailable as e:
         return _json_error(503, f"Engine not available: {e}")
     except (GenerationTimeout, asyncio.TimeoutError):
@@ -197,13 +353,18 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
     duration_ms = (time.monotonic() - t0) * 1000.0
     engine_md = None
     if engine_result is not None:
-        svc.metrics.ttft.observe(engine_result.ttft_ms / 1000.0)
-        svc.metrics.gen_latency.observe(duration_ms / 1000.0)
-        svc.metrics.tokens_generated.inc(max(engine_result.completion_tokens, 0))
-        if engine_result.tokens_per_sec:
-            svc.metrics.tokens_per_sec.set(engine_result.tokens_per_sec)
-        if engine_result.prefix_cache_hit:
-            svc.metrics.prefix_cache_hits.inc()
+        # Degraded rule-table responses stay out of the engine latency /
+        # throughput series: their ~0 ms TTFT and 10^5 tok/s would paint
+        # record-best dashboards during the exact outage the breaker
+        # metrics are surfacing (degraded_responses_total tracks them).
+        if not degraded:
+            svc.metrics.ttft.observe(engine_result.ttft_ms / 1000.0)
+            svc.metrics.gen_latency.observe(duration_ms / 1000.0)
+            svc.metrics.tokens_generated.inc(max(engine_result.completion_tokens, 0))
+            if engine_result.tokens_per_sec:
+                svc.metrics.tokens_per_sec.set(engine_result.tokens_per_sec)
+            if engine_result.prefix_cache_hit:
+                svc.metrics.prefix_cache_hits.inc()
         engine_md = EngineMetadata(
             queue_ms=engine_result.queue_ms,
             prefill_ms=engine_result.prefill_ms,
@@ -223,6 +384,7 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
         from_cache=from_cache,
         metadata=ExecutionMetadata(**build_metadata(start_iso, t0, True)),
         engine_metadata=engine_md,
+        degraded=degraded,
     )
     return web.json_response(body.model_dump())
 
@@ -281,8 +443,8 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
     token_q: asyncio.Queue = asyncio.Queue()
 
     async def supplier() -> str:
-        pieces: list[str] = []
-        try:
+        async def run() -> str:
+            pieces: list[str] = []
             stream = svc.engine.generate_stream(
                 render_prompt(sanitized_query),
                 max_tokens=svc.cfg.max_new_tokens,
@@ -292,7 +454,14 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
             async for piece in stream:
                 pieces.append(piece)
                 token_q.put_nowait(piece)
-            return parse_llm_output("".join(pieces))
+            return "".join(pieces)
+
+        try:
+            # Same breaker accounting as the non-streaming path; parsing
+            # stays outside so an unsafe output doesn't count as an
+            # engine failure.
+            text = await svc.run_engine(run)
+            return parse_llm_output(text)
         finally:
             token_q.put_nowait(_DONE)
 
@@ -331,10 +500,32 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
     except UnsafeCommandError as e:
         svc.metrics.unsafe_commands.labels("llm").inc()
         await write_safe(sse(str(e), event="error"))
-    except EngineUnavailable as e:
-        await write_safe(sse(f"engine unavailable: {e}", event="error"))
-    except (GenerationTimeout, asyncio.TimeoutError):
-        await write_safe(sse("LLM request timed out", event="error"))
+    except EngineOverloaded as e:
+        # Shedding stays an error even with the fallback enabled: the
+        # client should back off, not be absorbed by the rule table.
+        # (queue_rejections is counted inside run_engine, once per shed.)
+        await write_safe(sse(f"engine overloaded: {e}", event="error"))
+    except (EngineUnavailable, GenerationTimeout, asyncio.TimeoutError) as e:
+        if svc.fallback is not None:
+            try:
+                command, _result = await svc.degraded_command(
+                    sanitized_query, e)
+            except UnsafeCommandError as ue:
+                # A rule template interpolated a query capture the safety
+                # validator rejects ("logs of web;id") — same in-band 422
+                # analog as the primary-path unsafe case.
+                svc.metrics.unsafe_commands.labels("llm").inc()
+                await write_safe(sse(str(ue), event="error"))
+            else:
+                # A "degraded" frame before "done" so agent loops that
+                # only watch "done" keep working while aware clients can
+                # tell.
+                await write_safe(sse(command, event="degraded"))
+                await write_safe(sse(command, event="done"))
+        elif isinstance(e, EngineUnavailable):
+            await write_safe(sse(f"engine unavailable: {e}", event="error"))
+        else:
+            await write_safe(sse("LLM request timed out", event="error"))
     except Exception:
         # The 200 status is already on the wire; the best we can do is a
         # structured error event rather than a silently truncated stream.
@@ -379,24 +570,41 @@ async def handle_execute(request: web.Request) -> web.Response:
     return web.json_response(body.model_dump())
 
 
+def _device_count(app: web.Application) -> int:
+    """Device count, enumerated once and cached on the app: LBs probe
+    /health several times a second and re-importing jax + listing devices
+    per probe is measurable work for an answer that never changes."""
+    devices = app.get("_device_count")
+    if devices is None:
+        try:
+            import jax
+
+            devices = len(jax.devices())
+        except Exception:
+            return 0   # transient failure: don't cache; retry next probe
+        app["_device_count"] = devices
+    return devices
+
+
 async def handle_health(request: web.Request) -> web.Response:
-    """GET /health — readiness-gated (SURVEY.md §3.3)."""
+    """GET /health — readiness-gated (SURVEY.md §3.3), with the breaker's
+    state surfaced so operators can tell "engine down" from "engine up but
+    circuit open / serving fallback"."""
     svc: Service = request.app["service"]
     ready = bool(getattr(svc.engine, "ready", False))
-    devices = 0
-    try:
-        import jax
-
-        devices = len(jax.devices())
-    except Exception:
-        pass
+    breaker = svc.breaker.state
     body = HealthResponse(
-        status="healthy" if ready else "degraded",
+        status="healthy" if ready and breaker == "closed" else "degraded",
         engine=getattr(svc.engine, "name", "unknown"),
         engine_ready=ready,
         model=svc.cfg.model_name,
-        devices=devices,
+        devices=_device_count(request.app),
+        breaker=breaker,
+        degraded_fallback=svc.fallback is not None,
     )
+    # The HTTP status tracks engine readiness alone: an open breaker with
+    # the engine process alive still serves (fallback and/or cache), and
+    # half-open probes need traffic to ever re-close it.
     return web.json_response(body.model_dump(), status=200 if ready else 503)
 
 
@@ -455,6 +663,7 @@ async def handle_metrics(request: web.Request) -> web.Response:
         svc.metrics.queue_depth.set(stats.get("queue_depth", 0))
         svc.metrics.kv_pool_used.set(stats.get("kv_pages_used", 0))
         svc.metrics.kv_pool_total.set(stats.get("kv_pages_total", 0))
+    svc.metrics.breaker_state.set(STATE_CODES[svc.breaker.state])
     return web.Response(body=svc.metrics.render(), content_type="text/plain")
 
 
@@ -463,7 +672,8 @@ def create_app(cfg: ServiceConfig, engine: Engine,
                metrics: Optional[Metrics] = None) -> web.Application:
     """App factory (reference module init, app.py:130-138)."""
     app = web.Application(
-        middlewares=[observability_middleware, ratelimit_middleware, auth_middleware]
+        middlewares=[observability_middleware, overload_middleware,
+                     ratelimit_middleware, auth_middleware]
     )
     app["service"] = Service(cfg, engine, executor=executor, metrics=metrics)
 
@@ -481,6 +691,14 @@ def create_app(cfg: ServiceConfig, engine: Engine,
 
     async def _start_engine(app: web.Application) -> None:
         await app["service"].engine.start()
+        # Warm the /health device-count cache, but only when the engine
+        # already imported jax — a fake/openai deployment must not pay a
+        # multi-second jax import before the socket binds (the first
+        # health probe fills the cache lazily there instead).
+        import sys
+
+        if "jax" in sys.modules:
+            _device_count(app)
 
     async def _stop_engine(app: web.Application) -> None:
         # The DRAIN_TIMEOUT_SECS drain itself runs at signal time in

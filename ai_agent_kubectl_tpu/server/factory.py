@@ -45,31 +45,62 @@ class DegradedEngine:
         return f"DegradedEngine({self.reason!r})"
 
 
+def _build_inner(cfg: ServiceConfig, faults=None) -> Engine:
+    if cfg.engine == "fake":
+        return FakeEngine()
+    if cfg.engine == "openai":
+        return OpenAICompatEngine(
+            api_key=cfg.openai_api_key,
+            model=cfg.openai_model,
+            base_url=cfg.openai_base_url,
+            timeout=cfg.llm_timeout,
+        )
+    if cfg.engine in ("jax", "jax-batched"):
+        from .. import engine as _engine_pkg  # noqa: F401
+
+        # DECODE_BATCH_SIZE > 1 (the default) serves through the
+        # continuous-batching scheduler; =1 keeps the simpler
+        # single-sequence engine.
+        if cfg.engine == "jax-batched" or cfg.decode_batch_size > 1:
+            from ..engine.batcher import BatchedJaxEngine
+
+            return BatchedJaxEngine.from_config(cfg, faults=faults)
+        from ..engine.jax_engine import JaxEngine
+
+        return JaxEngine.from_config(cfg)
+    raise ValueError(f"Unknown ENGINE: {cfg.engine!r}")
+
+
 def build_engine(cfg: ServiceConfig) -> Engine:
-    try:
-        if cfg.engine == "fake":
-            return FakeEngine()
-        if cfg.engine == "openai":
-            return OpenAICompatEngine(
-                api_key=cfg.openai_api_key,
-                model=cfg.openai_model,
-                base_url=cfg.openai_base_url,
-                timeout=cfg.llm_timeout,
+    # Parse FAULT_POINTS OUTSIDE the degraded-start net: a typo'd drill
+    # spec must refuse to boot, not degrade-start into what looks like a
+    # real outage. ONE injector serves both the engine-internal points
+    # (admit/chunk, threaded into the batcher) and the generate-path
+    # ChaosEngine wrapper, so fired() counts and release()/clear() see
+    # every point.
+    from ..testing.faults import ChaosEngine, FaultInjector
+
+    injector = FaultInjector.from_spec(cfg.fault_points)
+    if injector is not None:
+        # admit/chunk are only checked by the continuous-batching engine;
+        # an armed point the selected engine can never fire would make the
+        # drill silently inert — refuse to boot instead.
+        needs_batcher = [p for p in ("admit", "chunk") if injector.has(p)]
+        batched = cfg.engine in ("jax", "jax-batched") and (
+            cfg.engine == "jax-batched" or cfg.decode_batch_size > 1)
+        if needs_batcher and not batched:
+            raise ValueError(
+                f"FAULT_POINTS {needs_batcher} are only wired into the "
+                "continuous-batching engine (ENGINE=jax with "
+                f"DECODE_BATCH_SIZE>1); inert under ENGINE={cfg.engine!r}"
             )
-        if cfg.engine in ("jax", "jax-batched"):
-            from .. import engine as _engine_pkg  # noqa: F401
-
-            # DECODE_BATCH_SIZE > 1 (the default) serves through the
-            # continuous-batching scheduler; =1 keeps the simpler
-            # single-sequence engine.
-            if cfg.engine == "jax-batched" or cfg.decode_batch_size > 1:
-                from ..engine.batcher import BatchedJaxEngine
-
-                return BatchedJaxEngine.from_config(cfg)
-            from ..engine.jax_engine import JaxEngine
-
-            return JaxEngine.from_config(cfg)
-        raise ValueError(f"Unknown ENGINE: {cfg.engine!r}")
+    try:
+        engine = _build_inner(cfg, faults=injector)
+        if injector is not None and injector.has("generate"):
+            logger.warning("FAULT_POINTS active on the generate path: %s",
+                           injector.describe())
+            return ChaosEngine(engine, injector)
+        return engine
     except Exception as e:
         logger.exception("Failed to initialize engine; starting degraded.")
         return DegradedEngine(f"engine init failed: {e}")

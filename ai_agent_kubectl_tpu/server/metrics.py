@@ -101,5 +101,24 @@ class Metrics:
             "engine_prefix_cache_hits_total", "Prefix-KV cache hits", registry=r
         )
 
+        # Failure-containment metrics (overload shedding / breaker /
+        # degraded fallback)
+        self.queue_rejections = Counter(
+            "queue_rejections_total",
+            "Requests shed by overload protection",
+            ["layer"],  # http (inflight cap) | engine (admission queue)
+            registry=r,
+        )
+        self.breaker_state = Gauge(
+            "breaker_state",
+            "Circuit breaker state (0=closed, 1=half-open, 2=open)",
+            registry=r,
+        )
+        self.degraded_responses = Counter(
+            "degraded_responses_total",
+            "Responses served by the rule-based fallback engine",
+            registry=r,
+        )
+
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -83,7 +83,11 @@ def build_openapi() -> Dict:
             "description": "Generation only — execution stays on "
                            "/execute (reference quirk B1, kept "
                            "deliberately). Served from the response "
-                           "cache on repeat queries (from_cache=true).",
+                           "cache on repeat queries (from_cache=true). "
+                           "With DEGRADED_FALLBACK=true, engine failures "
+                           "degrade to deterministic rule-based responses "
+                           "(degraded=true, engine_metadata.engine="
+                           "\"fallback-rules\") instead of 503.",
             "requestBody": _body("Query"),
             "responses": {
                 "200": _resp("CommandResponse", "Generated command with "
@@ -93,8 +97,12 @@ def build_openapi() -> Dict:
                 "422": _err("Generated command failed safety validation"),
                 "429": rate_err,
                 "500": _err("Internal error"),
-                "503": _err("Engine unavailable (degraded start or "
-                            "draining)"),
+                "503": _err("Engine unavailable (degraded start, "
+                            "draining, open circuit breaker) or "
+                            "overloaded — overload sheds (bounded "
+                            "admission queue / MAX_INFLIGHT_REQUESTS) "
+                            "carry a Retry-After header priced from the "
+                            "live queue drain rate"),
                 "504": _err("Generation exceeded LLM_TIMEOUT"),
             },
         }},
@@ -115,7 +123,11 @@ def build_openapi() -> Dict:
                 "200": {"description": "SSE stream (text/event-stream): "
                                        "token events, then 'event: done' "
                                        "— or 'event: error' with the "
-                                       "failure mapped in-band",
+                                       "failure mapped in-band. With "
+                                       "DEGRADED_FALLBACK=true an engine "
+                                       "failure emits 'event: degraded' "
+                                       "carrying the rule-based command, "
+                                       "then 'event: done'",
                         "content": {"text/event-stream": {
                             "schema": {"type": "string"}}}},
                 "400": _err("Invalid input query"),

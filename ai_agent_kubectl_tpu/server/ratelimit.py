@@ -11,9 +11,16 @@ quirk B3); here one enforcement point covers the rate-limited routes.
 
 from __future__ import annotations
 
+import math
 import time
 from collections import deque
 from typing import Callable, Deque, Dict, Tuple
+
+
+def ceil_seconds(seconds: float) -> int:
+    """Whole-second ceiling for Retry-After-style header values (shared by
+    the rate limiter and the overload-shed responses in server/app.py)."""
+    return math.ceil(seconds) if seconds > 0 else 0
 
 
 class SlidingWindowLimiter:
@@ -67,11 +74,14 @@ class SlidingWindowLimiter:
         return True, self.count - len(dq), 0.0
 
     def headers(self, remaining: int, retry_after: float) -> Dict[str, str]:
+        # X-RateLimit-Reset is delta-seconds until quota frees. The old
+        # value was int(monotonic + retry_after) — a process-relative
+        # timestamp no client could interpret.
         h = {
             "X-RateLimit-Limit": str(self.count),
             "X-RateLimit-Remaining": str(max(remaining, 0)),
-            "X-RateLimit-Reset": str(int(self._timer() + retry_after)),
+            "X-RateLimit-Reset": str(ceil_seconds(retry_after)),
         }
         if retry_after > 0:
-            h["Retry-After"] = str(max(1, int(retry_after + 0.999)))
+            h["Retry-After"] = str(max(1, ceil_seconds(retry_after)))
         return h

@@ -58,6 +58,10 @@ class CommandResponse(BaseModel):
     from_cache: bool = False
     metadata: ExecutionMetadata
     engine_metadata: Optional[EngineMetadata] = None
+    # True when the rule-based FallbackEngine served this response because
+    # the real engine was failing (DEGRADED_FALLBACK + open breaker);
+    # engine_metadata.engine is then "fallback-rules".
+    degraded: bool = False
 
 
 class HealthResponse(BaseModel):
@@ -69,3 +73,8 @@ class HealthResponse(BaseModel):
     engine_ready: bool = False
     model: str = ""
     devices: int = 0
+    # Failure-containment state (server/breaker.py): closed | half-open |
+    # open, and whether an open breaker degrades to rule-based responses
+    # instead of 503s.
+    breaker: str = "closed"
+    degraded_fallback: bool = False

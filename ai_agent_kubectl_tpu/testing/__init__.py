@@ -1,0 +1,6 @@
+"""Fault-injection / chaos-testing utilities (importable in production:
+``FAULT_POINTS`` wires them through config for game-day drills)."""
+
+from .faults import ChaosEngine, FaultInjector, InjectedFault
+
+__all__ = ["ChaosEngine", "FaultInjector", "InjectedFault"]

@@ -1,0 +1,239 @@
+"""Env-selectable fault-injection harness for the serving path.
+
+``FAULT_POINTS="admit:error:0.5,chunk:hang,generate:delay:2.0"`` arms named
+fault points that the engine layer checks at its seams:
+
+- ``admit``    — batcher admission (BatchedJaxEngine._admit_one/_admit_group)
+- ``chunk``    — batched decode dispatch (BatchedJaxEngine._dispatch_chunk;
+  a ``hang`` here blocks the scheduler thread exactly like a hung device
+  dispatch, which is what trips the engine watchdog)
+- ``generate`` — the whole engine call (applied by ``ChaosEngine``, the
+  protocol wrapper the factory installs when FAULT_POINTS names it)
+
+Modes (the third ``:``-field is mode-specific):
+
+- ``error[:rate]``  — raise ``InjectedFault`` (an ``EngineUnavailable``),
+  with optional probability ``rate`` in [0,1] (default 1.0 = always)
+- ``delay:seconds`` — sleep that long, then proceed
+- ``hang[:max_secs]`` — block until ``release()`` is called or ``max_secs``
+  elapses (default 60); models a dispatch that never completes
+
+The same injector object drives deterministic chaos tests programmatically
+(``set``/``release``/``clear``/``fired``) — tests/test_chaos.py is the
+consumer that proves the watchdog, load-shedding, and breaker paths.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+import threading
+import time
+from typing import AsyncIterator, Dict, Optional
+
+from ..engine.protocol import EngineResult, EngineUnavailable
+
+_DEFAULT_HANG_SECS = 60.0
+
+_MODES = ("error", "delay", "hang")
+
+#: the closed set of check sites; a typo'd point in FAULT_POINTS must be
+#: a startup error, not a silently inert game-day drill.
+KNOWN_POINTS = ("admit", "chunk", "generate")
+
+
+class InjectedFault(EngineUnavailable):
+    """A deliberately injected failure — maps to 503 like the real thing."""
+
+
+@dataclasses.dataclass
+class _Fault:
+    mode: str
+    arg: float          # delay seconds / max hang seconds; unused for error
+    rate: float = 1.0   # firing probability (error mode)
+    release_event: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+
+
+class FaultInjector:
+    """Named fault points checked synchronously (scheduler thread) or
+    asynchronously (engine wrappers)."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._faults: Dict[str, _Fault] = {}
+        self._fired: Dict[str, int] = {}
+        self._rng = random.Random(seed)
+
+    # ------------------------------------------------------------- config
+
+    @classmethod
+    def from_spec(cls, spec: str,
+                  seed: Optional[int] = None) -> Optional["FaultInjector"]:
+        """Parse a FAULT_POINTS spec; returns None for an empty spec."""
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        inj = cls(seed=seed)
+        seen = set()
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            parts = item.split(":")
+            if len(parts) < 2:
+                raise ValueError(
+                    f"FAULT_POINTS entry {item!r} must be point:mode[:arg]"
+                )
+            point, mode = parts[0].strip(), parts[1].strip().lower()
+            if point in seen:
+                # Last-wins would silently drop half the drill spec —
+                # same fail-fast rule as unknown points/modes.
+                raise ValueError(
+                    f"duplicate fault point {point!r} in FAULT_POINTS"
+                )
+            seen.add(point)
+            arg = float(parts[2]) if len(parts) > 2 else None
+            inj.set(point, mode, arg)
+        return inj
+
+    def set(self, point: str, mode: str, arg: Optional[float] = None) -> None:
+        """Arm ``point`` with ``mode``. ``arg`` is the error rate, delay
+        seconds, or max hang seconds depending on the mode."""
+        if point not in KNOWN_POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r}; valid: {KNOWN_POINTS}"
+            )
+        if mode not in _MODES:
+            raise ValueError(
+                f"fault mode must be one of {_MODES}, got {mode!r}"
+            )
+        if mode == "delay" and arg is None:
+            raise ValueError("delay mode needs seconds (point:delay:secs)")
+        if arg is not None and arg < 0:
+            # A negative delay would raise inside the scheduler loop and
+            # fail every active slot — a typo'd drill arg must be a
+            # startup error, same as a typo'd point or mode.
+            raise ValueError(f"fault arg must be >= 0, got {arg}")
+        rate = 1.0
+        if mode == "error":
+            rate = 1.0 if arg is None else float(arg)
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(f"error rate must be in [0,1], got {rate}")
+            arg = 0.0
+        if mode == "hang":
+            arg = _DEFAULT_HANG_SECS if arg is None else float(arg)
+        old = self._faults.get(point)
+        if old is not None:
+            # A thread may be blocked on the replaced fault's hang event;
+            # release it so re-arming never orphans a waiter for the old
+            # fault's full max_secs.
+            old.release_event.set()
+        self._faults[point] = _Fault(mode=mode, arg=float(arg), rate=rate)
+
+    def has(self, point: str) -> bool:
+        return point in self._faults
+
+    def fired(self, point: str) -> int:
+        """How many times ``point`` actually fired (rate misses excluded)."""
+        return self._fired.get(point, 0)
+
+    def release(self, point: str) -> None:
+        """Unblock a hang at ``point`` and disarm it."""
+        fault = self._faults.pop(point, None)
+        if fault is not None:
+            fault.release_event.set()
+
+    def clear(self) -> None:
+        for point in list(self._faults):
+            self.release(point)
+
+    # ------------------------------------------------------------ firing
+
+    def _arm(self, point: str) -> Optional[_Fault]:
+        fault = self._faults.get(point)
+        if fault is None:
+            return None
+        if fault.rate < 1.0 and self._rng.random() >= fault.rate:
+            return None
+        self._fired[point] = self._fired.get(point, 0) + 1
+        return fault
+
+    def check(self, point: str) -> None:
+        """Synchronous fault check — called from the scheduler thread, so a
+        hang here blocks it exactly like a hung device dispatch."""
+        fault = self._arm(point)
+        if fault is None:
+            return
+        if fault.mode == "error":
+            raise InjectedFault(f"injected fault at {point!r}")
+        if fault.mode == "delay":
+            time.sleep(fault.arg)
+            return
+        fault.release_event.wait(timeout=fault.arg)
+
+    async def acheck(self, point: str) -> None:
+        """Async fault check for coroutine call sites (ChaosEngine)."""
+        fault = self._arm(point)
+        if fault is None:
+            return
+        if fault.mode == "error":
+            raise InjectedFault(f"injected fault at {point!r}")
+        if fault.mode == "delay":
+            await asyncio.sleep(fault.arg)
+            return
+        deadline = time.monotonic() + fault.arg
+        while (not fault.release_event.is_set()
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.01)
+
+    def describe(self) -> str:
+        return ",".join(
+            f"{p}:{f.mode}" + (f":{f.rate}" if f.mode == "error"
+                               and f.rate < 1.0 else "")
+            for p, f in self._faults.items()
+        ) or "none"
+
+
+class ChaosEngine:
+    """Engine-protocol wrapper applying ``generate`` faults around any
+    backend — how env-driven chaos reaches engines that have no internal
+    fault points (fake, openai) and how tests break an otherwise-healthy
+    engine on demand."""
+
+    def __init__(self, inner, faults: FaultInjector):
+        self.inner = inner
+        self.faults = faults
+
+    @property
+    def name(self) -> str:
+        return self.inner.name
+
+    @property
+    def ready(self) -> bool:
+        return self.inner.ready
+
+    async def start(self) -> None:
+        await self.inner.start()
+
+    async def stop(self, drain_secs: float = 0.0) -> None:
+        await self.inner.stop(drain_secs)
+
+    def stats(self) -> dict:
+        fn = getattr(self.inner, "stats", None)
+        return fn() if callable(fn) else {}
+
+    def retry_after_hint(self) -> float:
+        fn = getattr(self.inner, "retry_after_hint", None)
+        return float(fn()) if callable(fn) else 1.0
+
+    async def generate(self, prompt: str, **kwargs) -> EngineResult:
+        await self.faults.acheck("generate")
+        return await self.inner.generate(prompt, **kwargs)
+
+    async def generate_stream(self, prompt: str,
+                              **kwargs) -> AsyncIterator[str]:
+        await self.faults.acheck("generate")
+        async for piece in self.inner.generate_stream(prompt, **kwargs):
+            yield piece

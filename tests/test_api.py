@@ -157,6 +157,8 @@ async def test_rate_limit_429():
         resp = await client.post("/kubectl-command", json={"query": "list pods"})
         assert resp.status == 429
         assert "Retry-After" in resp.headers
+        # Reset is delta-seconds within the window, not a monotonic epoch.
+        assert 0 < int(resp.headers["X-RateLimit-Reset"]) <= 60
     finally:
         await client.close()
 
@@ -476,5 +478,65 @@ async def test_openapi_document_served_and_complete():
         assert resp.status == 200
         html = await resp.text()
         assert "/openapi.json" in html and "/kubectl-command" in html
+    finally:
+        await client.close()
+
+
+async def test_stream_client_disconnect_still_fills_cache():
+    """A client dropping mid-SSE-stream must not cancel the shared
+    single-flight generation: it completes, fills the cache, and the
+    next (non-stream) request is served from_cache without a new engine
+    call (the documented SingleFlight semantics, previously unasserted)."""
+    engine = FakeEngine(delay=0.4)
+    client, _ = await make_client(make_cfg(), engine=engine)
+    try:
+        resp = await client.post("/kubectl-command/stream",
+                                 json={"query": "list all pods"})
+        assert resp.status == 200         # headers are sent pre-generation
+        resp.close()                      # drop the connection mid-stream
+        # the shared flight keeps running; wait for it to land in the cache
+        for _ in range(100):
+            if engine.calls == 1 and len(
+                    client.app["service"].cache.cache) == 1:
+                break
+            await asyncio.sleep(0.05)
+        resp2 = await client.post("/kubectl-command",
+                                  json={"query": "list all pods"})
+        body = await resp2.json()
+        assert body["from_cache"] is True
+        assert body["kubectl_command"] == "kubectl get pods"
+        assert engine.calls == 1          # no second generation
+    finally:
+        await client.close()
+
+
+async def test_metrics_label_cardinality_bounded():
+    """Scanner 404 traffic must not mint a Prometheus series per random
+    URL: unmatched routes collapse into one "unmatched" handler label."""
+    client, _ = await make_client(make_cfg())
+    try:
+        for path in ("/wp-admin.php", "/.env", "/random/deep/path-123"):
+            assert (await client.get(path)).status == 404
+        await client.get("/health")
+        text = await (await client.get("/metrics")).text()
+        assert 'handler="unmatched"' in text
+        assert "wp-admin" not in text and "path-123" not in text
+        assert 'handler="/health"' in text   # matched routes keep their path
+    finally:
+        await client.close()
+
+
+async def test_health_device_count_cached_at_startup():
+    """/health serves the device count enumerated once at startup instead
+    of re-importing jax and listing devices on every LB probe."""
+    client, _ = await make_client(make_cfg())
+    try:
+        cached = client.app["_device_count"]      # set by the startup hook
+        body = await (await client.get("/health")).json()
+        assert body["devices"] == cached
+        # prove the probe reads the cache, not a fresh enumeration
+        client.app["_device_count"] = cached + 7
+        body = await (await client.get("/health")).json()
+        assert body["devices"] == cached + 7
     finally:
         await client.close()

@@ -77,3 +77,52 @@ async def test_execute_rejects_non_kubectl():
     result = await ex.execute("ls -la")
     assert result["execution_error"]["code"] == "not_kubectl"
     assert result["metadata"]["success"] is False
+
+
+# ------------------------------- _reap: SIGTERM → 2 s grace → SIGKILL path
+
+
+async def test_reap_terminates_cooperative_process():
+    import asyncio
+    import sys
+
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-c", "import time; print('up', flush=True); time.sleep(60)",
+        stdout=asyncio.subprocess.PIPE,
+    )
+    await proc.stdout.readline()      # process is up
+    await CommandExecutor._reap(proc)
+    assert proc.returncode == -15     # SIGTERM sufficed; no escalation
+
+
+async def test_reap_escalates_to_sigkill_when_sigterm_ignored():
+    """The reference's missing escalation: a child that ignores SIGTERM
+    must be SIGKILLed after the 2 s grace, not leaked."""
+    import asyncio
+    import sys
+    import time
+
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-c",
+        "import signal, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "print('armed', flush=True)\n"
+        "time.sleep(60)\n",
+        stdout=asyncio.subprocess.PIPE,
+    )
+    await proc.stdout.readline()      # SIGTERM handler installed
+    t0 = time.monotonic()
+    await CommandExecutor._reap(proc)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == -9      # escalated to SIGKILL
+    assert 1.5 <= elapsed < 10.0      # after the ~2 s terminate grace
+
+
+async def test_reap_handles_already_dead_process():
+    import asyncio
+    import sys
+
+    proc = await asyncio.create_subprocess_exec(sys.executable, "-c", "pass")
+    await proc.wait()
+    await CommandExecutor._reap(proc)  # ProcessLookupError path: no raise
+    assert proc.returncode == 0

@@ -179,6 +179,13 @@ def test_rate_limiter_headers():
     h = rl.headers(0, 12.3)
     assert h["Retry-After"] == "13"
     assert h["X-RateLimit-Limit"] == "10"
+    # Reset is delta-seconds until quota frees — NOT the old monotonic
+    # timestamp (int(monotonic + retry_after)), which was meaningless to
+    # clients.
+    assert h["X-RateLimit-Reset"] == "13"
+    h2 = rl.headers(5, 0.0)
+    assert h2["X-RateLimit-Reset"] == "0"
+    assert "Retry-After" not in h2
 
 
 # -------------------------------------------------------------------- config
