@@ -112,6 +112,11 @@ class ServiceConfig:
     execution_timeout: float = 30.0         # EXECUTION_TIMEOUT seconds
     rate_limit: str = "10/minute"           # RATE_LIMIT
     log_level: str = "INFO"                 # LOG_LEVEL
+    # Log line format: "text" keeps the reference's human format; "json"
+    # emits one JSON object per line, stamped with the active request ID
+    # (obs/trace.py) so a flight-recorder lookup and a log grep meet on
+    # the same key.
+    log_format: str = "text"                # LOG_FORMAT: text | json
     host: str = "0.0.0.0"                   # HOST
     port: int = 8000                        # PORT
     # Honour X-Forwarded-For for rate-limit keying ONLY behind a trusted
@@ -205,6 +210,16 @@ class ServiceConfig:
     # Fault-injection harness (testing/faults.py):
     # "admit:error:0.5,chunk:hang,generate:delay:2.0". Empty disables.
     fault_points: str = ""                  # FAULT_POINTS
+
+    # --- observability ---
+    # Flight recorder: keep the full span timeline of the last N requests
+    # (including shed/degraded/errored) for /debug/requests lookups.
+    flight_recorder_size: int = 256         # FLIGHT_RECORDER_SIZE
+    # Debug-endpoint token: when set, /debug/* additionally requires
+    # X-Debug-Token (profiler captures and request timelines are
+    # operator-facing, not client-facing). Unset = only API-key auth
+    # (when enabled) guards them.
+    debug_token: Optional[str] = None       # DEBUG_TOKEN
     # Graceful shutdown: stop accepting new requests, wait up to this long
     # for in-flight generations to finish, then abort what remains.
     drain_timeout_secs: float = 10.0        # DRAIN_TIMEOUT_SECS
@@ -255,6 +270,7 @@ class ServiceConfig:
             execution_timeout=_env_float("EXECUTION_TIMEOUT", 30.0),
             rate_limit=_env_str("RATE_LIMIT", "10/minute"),
             log_level=(_env_str("LOG_LEVEL", "INFO") or "INFO").upper(),
+            log_format=(_env_str("LOG_FORMAT", "text") or "text").lower(),
             host=_env_str("HOST", "0.0.0.0"),
             port=_env_int("PORT", 8000),
             trust_proxy_headers=_env_bool("TRUST_PROXY_HEADERS", False),
@@ -287,6 +303,8 @@ class ServiceConfig:
             breaker_window_secs=_env_float("BREAKER_WINDOW_SECS", 30.0),
             breaker_recovery_secs=_env_float("BREAKER_RECOVERY_SECS", 15.0),
             fault_points=_env_str("FAULT_POINTS", "") or "",
+            flight_recorder_size=_env_int("FLIGHT_RECORDER_SIZE", 256),
+            debug_token=_env_str("DEBUG_TOKEN", None),
             drain_timeout_secs=_env_float("DRAIN_TIMEOUT_SECS", 10.0),
             compile_cache_dir=os.getenv(
                 "COMPILE_CACHE_DIR", "~/.cache/ai-agent-kubectl-tpu/xla-cache"
@@ -305,7 +323,7 @@ class ServiceConfig:
     def describe(self) -> dict:
         """Loggable, secret-free view of the config."""
         d = {f.name: getattr(self, f.name) for f in fields(self) if f.init}
-        for secret in ("api_auth_key", "openai_api_key"):
+        for secret in ("api_auth_key", "openai_api_key", "debug_token"):
             if d.get(secret):
                 d[secret] = "***"
         return d

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import KVCache, forward
+from ..obs.trace import Trace, current_trace
 from ..ops.quant import (kv_broadcast_rows, kv_set_slots, kv_slot_update,
                          kv_tokens, kv_update_slice)
 from .jax_engine import JaxEngine
@@ -104,6 +105,12 @@ class _Request:
     out_queue: asyncio.Queue
     cancel: threading.Event
     t_submit: float
+    # Request-lifecycle trace (obs/trace.py), captured from the submitting
+    # coroutine's context. ContextVars don't cross threads, so the
+    # scheduler annotates THIS reference (Trace.event is lock-guarded) —
+    # the flight-recorder timeline shows admissions/first-token/finish
+    # as the scheduler saw them.
+    trace: Optional[Trace] = None
 
 
 @dataclasses.dataclass
@@ -128,6 +135,7 @@ class _Slot:
     chunks_inflight: int = 0      # dispatched-but-unconsumed entries for this slot
     exhausted: bool = False       # KV capacity reached; drain pipeline, then finish
     prefix_hit: bool = False      # served from the system-prompt prefix-KV cache
+    detok_ms: float = 0.0         # host detokenization time, accumulated
 
 
 class BatchedJaxEngine(JaxEngine):
@@ -179,6 +187,12 @@ class BatchedJaxEngine(JaxEngine):
         # prices Retry-After on sheds. Appended from the scheduler thread,
         # read racily from the event loop — fine for a hint.
         self._finish_times: collections.deque = collections.deque(maxlen=64)
+        # (t, completion_tokens) per finish, feeding the windowed
+        # engine_tokens_per_sec gauge via stats(). Scheduler-thread
+        # appends, racy event-loop reads — fine for a gauge. maxlen bounds
+        # memory; 4096 finishes inside one window is beyond the gauge's
+        # resolution needs anyway.
+        self._token_finishes: collections.deque = collections.deque(maxlen=4096)
         self._admissions: _queue.Queue = _queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._running = False
@@ -574,6 +588,13 @@ class BatchedJaxEngine(JaxEngine):
             -(-min(s.pos, self.max_seq_len) // page)
             for s in slots if s is not None
         )
+        # Windowed decode throughput (engine_tokens_per_sec): tokens
+        # completed over the trailing window, counted at the scheduler —
+        # covers every finish (streams included), immune to the
+        # last-writer race the old per-request gauge had.
+        horizon = time.monotonic() - self.TOKEN_RATE_WINDOW_SECS
+        tok_window = sum(n for t, n in list(self._token_finishes)
+                         if t >= horizon)
         return {
             "batch_occupancy": sum(s is not None for s in slots),
             "queue_depth": self._admissions.qsize(),
@@ -581,12 +602,16 @@ class BatchedJaxEngine(JaxEngine):
             "kv_pages_total": self.batch_size * pages_per_slot,
             "queue_rejections": self._rejections,
             "max_queue_depth": self.max_queue_depth,
+            "tokens_per_sec_window": tok_window / self.TOKEN_RATE_WINDOW_SECS,
         }
 
     #: finish timestamps older than this don't feed the drain-rate
     #: estimate — after an idle hour the first shed must not price
     #: Retry-After off a rate diluted by the gap.
     DRAIN_RATE_HORIZON_SECS = 60.0
+
+    #: averaging window for the stats() tokens_per_sec_window rate.
+    TOKEN_RATE_WINDOW_SECS = 60.0
 
     def retry_after_hint(self, extra_depth: int = 0) -> float:
         """Seconds until queued work plausibly drains, from the live
@@ -977,6 +1002,10 @@ class BatchedJaxEngine(JaxEngine):
                 chunks_inflight=1,
                 prefix_hit=True,
             )
+            if req.trace is not None:
+                req.trace.event(
+                    f"engine: group-admitted to slot {slot_idx} "
+                    f"(burst of {len(live)}, suffix bucket {sbucket})")
             pairs.append((req, slot_idx))
 
         self._cache, self._tok_d, self._pos_d, self._temps_d = (
@@ -1034,6 +1063,10 @@ class BatchedJaxEngine(JaxEngine):
             chunks_inflight=1,
             prefix_hit=prefix_hit,
         )
+        if req.trace is not None:
+            req.trace.event(
+                f"engine: admitted to slot {slot_idx} "
+                f"({n_prompt} prompt tokens, prefix_hit={prefix_hit})")
         self._slots[slot_idx] = slot
         # Start the device→host copy immediately: transfers overlap each
         # other and device compute, so the blocking read at consume time
@@ -1057,10 +1090,14 @@ class BatchedJaxEngine(JaxEngine):
         slot.t_first = now
         slot.t_decode0 = now
         slot.prefill_ms = (now - slot.t_admit) * 1000.0
+        if req.trace is not None:
+            req.trace.event("engine: first token")
         if first_tok in self.model_cfg.eos_ids:
             self._finish(slot_idx, "stop")
             return
+        t_dk = time.monotonic()
         piece = slot.detok.push(first_tok)
+        slot.detok_ms += (time.monotonic() - t_dk) * 1000.0
         if piece is not None:
             self._emit(req, "token", piece)
         if req.max_tokens <= 1:
@@ -1239,7 +1276,9 @@ class BatchedJaxEngine(JaxEngine):
             if new_ids:
                 if slot.t_first is None:
                     slot.t_first = time.monotonic()
+                t_dk = time.monotonic()
                 piece = slot.detok.push(*new_ids)
+                slot.detok_ms += (time.monotonic() - t_dk) * 1000.0
                 if piece is not None:
                     self._emit(slot.req, "token", piece)
             if finish is not None:
@@ -1255,12 +1294,22 @@ class BatchedJaxEngine(JaxEngine):
         # the drain-rate estimate behind retry_after_hint().
         self._finish_times.append(time.monotonic())
         if error is not None:
+            if slot.req.trace is not None:
+                slot.req.trace.event(
+                    f"engine: failed ({finish}): {error}")
             self._emit(slot.req, "error", error)
             return
+        t_dk = time.monotonic()
         piece = slot.detok.flush()
+        slot.detok_ms += (time.monotonic() - t_dk) * 1000.0
         if piece is not None:
             self._emit(slot.req, "token", piece)
         t_end = time.monotonic()
+        self._token_finishes.append((t_end, len(slot.detok.ids)))
+        if slot.req.trace is not None:
+            slot.req.trace.event(
+                f"engine: finished ({finish}, "
+                f"{len(slot.detok.ids)} tokens)")
         result = EngineResult(
             text=slot.detok.text,
             prompt_tokens=slot.n_prompt,
@@ -1268,6 +1317,7 @@ class BatchedJaxEngine(JaxEngine):
             queue_ms=slot.queue_ms,
             prefill_ms=slot.prefill_ms,
             decode_ms=(t_end - slot.t_decode0) * 1000.0,
+            detok_ms=slot.detok_ms,
             ttft_ms=((slot.t_first or t_end) - slot.req.t_submit) * 1000.0,
             prefix_cache_hit=slot.prefix_hit,
             finish_reason=finish,
@@ -1295,9 +1345,13 @@ class BatchedJaxEngine(JaxEngine):
         # request would wait multiple full batches for a slot — reject in
         # microseconds with a drain-rate-priced Retry-After rather than
         # holding the connection until the 504 at llm_timeout.
+        trace = current_trace()
         depth = self._admissions.qsize()
         if self.max_queue_depth and depth >= self.max_queue_depth:
             self._rejections += 1
+            if trace is not None:
+                trace.event(f"engine: admission queue full "
+                            f"({depth}/{self.max_queue_depth}) — shed")
             raise EngineOverloaded(
                 f"admission queue full ({depth}/{self.max_queue_depth})",
                 retry_after=self.retry_after_hint(),
@@ -1314,7 +1368,11 @@ class BatchedJaxEngine(JaxEngine):
             out_queue=asyncio.Queue(),
             cancel=threading.Event(),
             t_submit=t_submit,
+            trace=trace,
         )
+        if trace is not None:
+            trace.event(f"engine: submitted to batch scheduler "
+                        f"(queue depth {depth})")
         self._admissions.put(req)
         try:
             while True:

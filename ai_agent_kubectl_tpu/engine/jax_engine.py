@@ -1026,6 +1026,7 @@ class JaxEngine:
         temp_d = jnp.asarray(temperature, jnp.float32)
 
         detok = StreamDecoder(self.tokenizer)  # detok.ids = generated tokens
+        detok_ms = 0.0                         # host detok time, accumulated
         t_first = None
         t_decode0 = time.monotonic()
         prefill_ms = (t_decode0 - t_prefill0) * 1000.0
@@ -1041,7 +1042,9 @@ class JaxEngine:
             finish = "stop"
             stopped = True
         else:
+            t_dk = time.monotonic()
             piece = detok.push(first_id)
+            detok_ms += (time.monotonic() - t_dk) * 1000.0
             if piece is not None:
                 yield ("token", piece)
             if max_tokens <= 1:
@@ -1124,14 +1127,18 @@ class JaxEngine:
                     if len(detok.ids) + len(new_ids) >= max_tokens:
                         stopped = True
                         break
+                t_dk = time.monotonic()
                 piece = detok.push(*new_ids) if new_ids else None
+                detok_ms += (time.monotonic() - t_dk) * 1000.0
                 if piece is not None:
                     yield ("token", piece)
                 if stopped:
                     break
 
         # Flush any held-back tail (genuinely invalid bytes stay U+FFFD).
+        t_dk = time.monotonic()
         piece = detok.flush()
+        detok_ms += (time.monotonic() - t_dk) * 1000.0
         if piece is not None:
             yield ("token", piece)
 
@@ -1143,6 +1150,7 @@ class JaxEngine:
             completion_tokens=len(detok.ids),
             prefill_ms=prefill_ms,
             decode_ms=decode_ms,
+            detok_ms=detok_ms,
             ttft_ms=((t_first or t_end) - t_start) * 1000.0,
             prefix_cache_hit=prefix_hit,
             finish_reason=finish,
@@ -1185,6 +1193,9 @@ class JaxEngine:
                              temperature: float, timeout: Optional[float]):
         if not self._ready:
             raise EngineUnavailable("JaxEngine not started")
+        from ..obs.trace import trace_event
+
+        trace_event("engine: submitted to single-sequence engine")
         t_queue0 = time.monotonic()
         deadline = (t_queue0 + timeout) if timeout else None
         # Count this request as in flight from acceptance, INCLUDING the

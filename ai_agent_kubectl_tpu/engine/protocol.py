@@ -45,6 +45,10 @@ class EngineResult:
     # latency (admit → first token), which includes pipeline wait.
     prefill_ms: float = 0.0
     decode_ms: float = 0.0
+    # Host-side detokenization time (token IDs → text pieces), accumulated
+    # across the generation. Subset of decode_ms wall time on engines that
+    # interleave detok with decode; 0 when the engine doesn't measure it.
+    detok_ms: float = 0.0
     ttft_ms: float = 0.0
     prefix_cache_hit: bool = False
     finish_reason: str = "stop"  # stop | length | abort

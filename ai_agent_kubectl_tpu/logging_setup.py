@@ -1,14 +1,76 @@
-"""Logging configuration matching the reference's format (app.py:38-47)."""
+"""Logging configuration (reference app.py:38-47) + structured JSON mode.
+
+``LOG_FORMAT=text`` (default) keeps the reference's human format.
+``LOG_FORMAT=json`` emits one JSON object per line — timestamp, level,
+logger, message, and the active request ID from the trace context
+(obs/trace.py) — so a slow request found in the flight recorder and its
+log lines meet on the same ``request_id`` key. The request-ID filter is
+installed in BOTH modes (text lines append a ``[rid]`` suffix when a
+trace is active), because the ID is what makes a 3 am log excerpt
+actionable.
+"""
 
 from __future__ import annotations
 
+import datetime
+import json
 import logging
 
+from .obs.trace import current_trace
 
-def setup_logging(level: str = "INFO") -> logging.Logger:
+
+class RequestIdFilter(logging.Filter):
+    """Stamp every record with the active request's ID (or None).
+
+    A Filter rather than a Formatter concern so ``record.request_id``
+    exists even for records a third-party formatter renders."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        trace = current_trace()
+        record.request_id = trace.request_id if trace is not None else None
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; stdlib only."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": datetime.datetime.fromtimestamp(
+                record.created, tz=datetime.timezone.utc
+            ).isoformat(),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+            "request_id": getattr(record, "request_id", None),
+        }
+        if record.exc_info:
+            entry["exc_info"] = self.formatException(record.exc_info)
+        # default=repr: a bad interpolation argument must never take the
+        # logging pipeline down with a serialization error.
+        return json.dumps(entry, default=repr)
+
+
+class TextFormatter(logging.Formatter):
+    """Reference format, plus a ``[rid]`` suffix when a trace is active."""
+
+    def __init__(self) -> None:
+        super().__init__("%(asctime)s - %(name)s - %(levelname)s - %(message)s")
+
+    def format(self, record: logging.LogRecord) -> str:
+        line = super().format(record)
+        rid = getattr(record, "request_id", None)
+        return f"{line} [{rid}]" if rid else line
+
+
+def setup_logging(level: str = "INFO", fmt: str = "text") -> logging.Logger:
+    handler = logging.StreamHandler()
+    handler.addFilter(RequestIdFilter())
+    handler.setFormatter(JsonFormatter() if fmt == "json" else TextFormatter())
     logging.basicConfig(
         level=getattr(logging, level.upper(), logging.INFO),
-        format="%(asctime)s - %(name)s - %(levelname)s - %(message)s",
+        handlers=[handler],
+        force=True,
     )
     return logging.getLogger("ai_agent_kubectl_tpu")
 
@@ -23,4 +85,9 @@ def startup_warnings(cfg) -> None:
     if cfg.engine == "openai" and not cfg.openai_api_key:
         logger.error(
             "ENGINE=openai but OPENAI_API_KEY not set; engine will run degraded (503)."
+        )
+    if not cfg.debug_token:
+        logger.info(
+            "DEBUG_TOKEN not set: /debug/* endpoints are guarded only by "
+            "API-key auth (when enabled)."
         )

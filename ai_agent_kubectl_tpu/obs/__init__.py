@@ -1,0 +1,34 @@
+"""Observability subsystem: request-lifecycle tracing, flight recorder,
+and on-demand TPU profiling.
+
+Zero-dependency (stdlib only) by design — the trace context is touched on
+the serving hot path and from the batch scheduler thread, so it must never
+import jax, aiohttp, or prometheus_client. Three pieces:
+
+- ``obs.trace`` — request ID + span API with monotonic timestamps. The
+  active trace travels via a ``contextvars.ContextVar`` through the async
+  serving path (middleware → cache → breaker → engine submit → executor)
+  and by explicit reference through the batch scheduler's admission queue
+  (``_Request.trace``), whose worker thread annotates it lock-safely.
+- ``obs.recorder`` — ring-buffer flight recorder keeping the full span
+  timeline of the last N finished requests (including shed / degraded /
+  errored ones), served by ``/debug/requests[/{id}]``.
+- ``obs.profiler`` — on-demand ``jax.profiler`` device-trace capture for
+  ``POST /debug/profile`` (token-gated), so a TPU trace can be grabbed
+  from a live server without restarting it.
+"""
+
+from .recorder import FlightRecorder
+from .trace import (PHASES, Trace, current_trace, new_request_id,
+                    sanitize_request_id, trace_event, use_trace)
+
+__all__ = [
+    "PHASES",
+    "FlightRecorder",
+    "Trace",
+    "current_trace",
+    "new_request_id",
+    "sanitize_request_id",
+    "trace_event",
+    "use_trace",
+]

@@ -1,0 +1,73 @@
+"""On-demand ``jax.profiler`` capture for a live server.
+
+``POST /debug/profile?seconds=N`` lands here: start a device trace into a
+fresh directory, sleep N seconds while live traffic keeps decoding, stop,
+and report the directory (TensorBoard-loadable, ``xprof`` readable). The
+whole point is catching "why is decode slow *right now*" without
+restarting the server with profiling baked in.
+
+jax is imported lazily inside the capture — the obs package must stay
+importable (and the fake/openai deployments must stay jax-free) when no
+one ever asks for a profile.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import shutil
+import tempfile
+import time
+
+logger = logging.getLogger(__name__)
+
+#: traces are tens of MB each; keep the newest few and reap the rest.
+KEEP_TRACES = 4
+
+#: capture length clamp (seconds): long enough for a few decode chunks,
+#: short enough that an operator typo can't profile for an hour.
+MIN_SECONDS = 0.1
+MAX_SECONDS = 30.0
+
+
+def clamp_seconds(seconds: float) -> float:
+    return min(max(float(seconds), MIN_SECONDS), MAX_SECONDS)
+
+
+def trace_base_dir() -> str:
+    return os.path.join(tempfile.gettempdir(), "ai-agent-kubectl-tpu-traces")
+
+
+def _reap_old(base: str) -> None:
+    old = sorted(
+        d for d in os.listdir(base) if os.path.isdir(os.path.join(base, d))
+    )
+    if len(old) > KEEP_TRACES:
+        for d in old[:-KEEP_TRACES]:
+            shutil.rmtree(os.path.join(base, d), ignore_errors=True)
+
+
+async def capture(seconds: float) -> dict:
+    """Run one profiler capture; returns ``{"trace_dir", "seconds"}``.
+
+    The caller serializes captures (one at a time) — jax.profiler has one
+    global trace session and a second start_trace would raise.
+    """
+    import jax
+
+    seconds = clamp_seconds(seconds)
+    base = trace_base_dir()
+    os.makedirs(base, exist_ok=True)
+    _reap_old(base)
+    trace_dir = tempfile.mkdtemp(
+        prefix=f"{time.strftime('%Y%m%d-%H%M%S')}-", dir=base
+    )
+    logger.info("profiler: capturing %.1fs device trace into %s",
+                seconds, trace_dir)
+    jax.profiler.start_trace(trace_dir)
+    try:
+        await asyncio.sleep(seconds)
+    finally:
+        jax.profiler.stop_trace()
+    return {"trace_dir": trace_dir, "seconds": seconds}

@@ -15,7 +15,7 @@ from .factory import build_engine
 
 def main() -> None:
     cfg = ServiceConfig.from_env()
-    logger = setup_logging(cfg.log_level)
+    logger = setup_logging(cfg.log_level, cfg.log_format)
     startup_warnings(cfg)
     logger.info("Config: %s", cfg.describe())
     if cfg.distributed_init or cfg.coordinator_address:

@@ -24,8 +24,10 @@ Retry-After; API-key auth via ``X-API-Key`` (app.py:140-151), disabled when
 from __future__ import annotations
 
 import asyncio
+import hmac
 import logging
 import time
+from contextlib import nullcontext
 from typing import Optional
 
 from aiohttp import web
@@ -36,10 +38,13 @@ from ..engine.fallback import FallbackEngine
 from ..engine.protocol import (Engine, EngineOverloaded, EngineResult,
                                EngineUnavailable, GenerationTimeout)
 from ..engine.prompts import render_prompt
+from ..obs import (PHASES, FlightRecorder, Trace, current_trace,
+                   new_request_id, sanitize_request_id, use_trace)
+from ..obs import profiler as obs_profiler
 from .breaker import STATE_CODES, CircuitBreaker
 from .cache import CachedSingleFlight
 from .executor import CommandExecutor, build_metadata, utcnow_iso
-from .metrics import Metrics
+from .metrics import Metrics, WindowedRate
 from .output_parser import UnsafeCommandError, parse_llm_output
 from .ratelimit import SlidingWindowLimiter, ceil_seconds
 from .sanitize import sanitize_query
@@ -55,14 +60,31 @@ from .schemas import (
 logger = logging.getLogger(__name__)
 
 RATE_LIMITED_ROUTES = {"/kubectl-command", "/kubectl-command/stream", "/execute"}
-AUTH_ROUTES = RATE_LIMITED_ROUTES | {"/debug/trace"}
+#: /debug/* is matched by prefix in auth_middleware (the flight-recorder
+#: lookup route carries a path parameter, so exact-set membership can't
+#: cover it).
+AUTH_ROUTES = RATE_LIMITED_ROUTES
 #: routes the MAX_INFLIGHT_REQUESTS overload gate covers (the ones that
 #: occupy the engine).
 GENERATE_ROUTES = {"/kubectl-command", "/kubectl-command/stream"}
+#: paths the flight recorder skips: LB health probes and Prometheus
+#: scrapes arrive several times a second and would flush every real
+#: request out of the ring within a minute; recorder lookups recording
+#: themselves would do the same.
+UNRECORDED_PATHS = ("/health", "/metrics", "/debug/", "/openapi.json", "/docs")
 
 
 def _retry_after_header(seconds: float) -> dict:
     return {"Retry-After": str(max(1, ceil_seconds(seconds)))}
+
+
+def _span(name: str, **meta):
+    """Span on the active trace, or a no-op when none is active (unit
+    tests driving Service methods directly)."""
+    trace = current_trace()
+    if trace is not None:
+        return trace.span(name, **meta)
+    return nullcontext()
 
 
 def _client_key(request: web.Request) -> str:
@@ -108,6 +130,11 @@ class Service:
             FallbackEngine() if cfg.degraded_fallback else None
         )
         self.inflight_requests = 0
+        # Observability: the flight recorder keeps the last N request
+        # timelines for /debug/requests; the windowed rate feeds the
+        # engine_tokens_per_sec gauge at scrape time (see WindowedRate).
+        self.recorder = FlightRecorder(cfg.flight_recorder_size)
+        self.token_rate = WindowedRate()
 
     def retry_after_hint(self) -> float:
         """Retry-After for HTTP-layer sheds: the engine's drain-rate
@@ -152,6 +179,9 @@ class Service:
             # the handlers, where every coalesced single-flight waiter
             # re-raising the shared exception would inflate the counter.
             self.metrics.queue_rejections.labels("engine").inc()
+            trace = current_trace()
+            if trace is not None:
+                trace.shed = True
             raise
         except Exception:
             decided = True
@@ -177,7 +207,13 @@ class Service:
             "Serving degraded fallback for query '%s' (breaker=%s): %s",
             sanitized_query, self.breaker.state, cause,
         )
-        result = await self.fallback.generate(render_prompt(sanitized_query))
+        trace = current_trace()
+        if trace is not None:
+            trace.degraded = True
+            trace.event(f"fallback: engine failed ({cause}); serving "
+                        f"rule-based response (breaker={self.breaker.state})")
+        with _span("fallback"):
+            result = await self.fallback.generate(render_prompt(sanitized_query))
         command = parse_llm_output(result.text)
         self.metrics.degraded_responses.inc()
         # The request DID consult the response cache and miss before the
@@ -204,7 +240,8 @@ class Service:
                 timeout=self.cfg.llm_timeout,
             ))
             last_result[0] = result
-            command = parse_llm_output(result.text)
+            with _span("safety"):
+                command = parse_llm_output(result.text)
             logger.info(
                 "Engine generated command for query '%s': %s", sanitized_query, command
             )
@@ -234,27 +271,77 @@ class Service:
         return command, from_cache, last_result[0], False
 
 
+def _finalize_trace(svc: "Service", trace: Trace, status: int,
+                    canonical_path: str) -> None:
+    """Close out a request's trace: status, phase histograms, recorder.
+
+    Runs for EVERY request — shed 503s, rate-limited 429s, auth 401s and
+    unhandled 500s included — which is exactly what makes the flight
+    recorder useful during an incident. Probe/scrape/debug paths stay out
+    of the recorder (they would flush real traffic from the ring) but
+    still feed the HTTP metrics.
+    """
+    trace.finish(status=status)
+    for phase, ms in trace.phase_durations().items():
+        # PHASES is a fixed allowlist: label cardinality stays bounded no
+        # matter what span names a future code path (or bug) produces.
+        if phase in PHASES:
+            svc.metrics.request_phase.labels(phase).observe(ms / 1000.0)
+    # Unmatched-route 404s stay out too: they bypass the rate limiter
+    # (it only covers the serving routes), so an anonymous scanner
+    # walking random URLs could otherwise flush every real timeline out
+    # of the ring in seconds. They still count in http_requests_total.
+    if (canonical_path != "unmatched"
+            and not canonical_path.startswith(UNRECORDED_PATHS)):
+        svc.recorder.record(trace)
+
+
 @web.middleware
 async def observability_middleware(request: web.Request, handler):
+    """Outermost middleware: request-ID minting, trace-context scope, HTTP
+    metrics, Server-Timing, and the flight recorder. Wraps the overload/
+    ratelimit/auth middlewares so even their rejections carry an
+    X-Request-ID and land in the recorder."""
     svc: Service = request.app["service"]
-    start = time.monotonic()
     # Label by the matched route's canonical path, never the raw request
     # path: a scanner walking random 404 URLs would otherwise mint a new
     # Prometheus series per URL and grow /metrics without bound.
     resource = getattr(request.match_info.route, "resource", None)
     path = resource.canonical if resource is not None else "unmatched"
+    # Honour a (safe) client-provided X-Request-ID so callers can
+    # pre-correlate; mint otherwise. The raw request path goes on the
+    # trace (it names ONE request, not a Prometheus series).
+    rid = sanitize_request_id(request.headers.get("X-Request-ID")) \
+        or new_request_id()
+    trace = Trace(rid, request.method, request.path)
+    request["trace"] = trace
     status = 500
     try:
-        response = await handler(request)
+        with use_trace(trace):
+            response = await handler(request)
         status = response.status
+        if not getattr(response, "prepared", False):
+            # Headers are still mutable (json_response et al.). Streaming
+            # responses sent their headers at prepare() time — the SSE
+            # handler stamps X-Request-ID itself before preparing.
+            response.headers["X-Request-ID"] = rid
+            timing = trace.server_timing()
+            if timing:
+                response.headers["Server-Timing"] = timing
         return response
     except web.HTTPException as e:
         status = e.status
+        e.headers["X-Request-ID"] = rid
+        trace.error = type(e).__name__
+        raise
+    except Exception as e:
+        trace.error = f"{type(e).__name__}: {e}"
         raise
     finally:
-        elapsed = time.monotonic() - start
+        elapsed = (time.monotonic() - trace.t0)
         svc.metrics.http_requests.labels(request.method, path, str(status)).inc()
         svc.metrics.http_latency.labels(request.method, path).observe(elapsed)
+        _finalize_trace(svc, trace, status, path)
 
 
 @web.middleware
@@ -270,6 +357,11 @@ async def overload_middleware(request: web.Request, handler):
         return await handler(request)
     if svc.inflight_requests >= cap:
         svc.metrics.queue_rejections.labels("http").inc()
+        trace = current_trace()
+        if trace is not None:
+            trace.shed = True
+            trace.event(f"overload: inflight cap reached "
+                        f"({svc.inflight_requests}/{cap}); shedding")
         retry = svc.retry_after_hint()
         return _json_error(
             503,
@@ -291,6 +383,10 @@ async def ratelimit_middleware(request: web.Request, handler):
         allowed, remaining, retry_after = svc.limiter.check(_client_key(request))
         if not allowed:
             svc.metrics.rate_limited.inc()
+            trace = current_trace()
+            if trace is not None:
+                trace.shed = True
+                trace.event("ratelimit: client over quota; rejecting")
             return _json_error(
                 429,
                 f"Rate limit exceeded: {svc.cfg.rate_limit}",
@@ -304,7 +400,8 @@ async def auth_middleware(request: web.Request, handler):
     """X-API-Key auth (reference app.py:140-151); disabled when no key
     configured."""
     svc: Service = request.app["service"]
-    if svc.cfg.auth_enabled and request.path in AUTH_ROUTES:
+    if svc.cfg.auth_enabled and (request.path in AUTH_ROUTES
+                                 or request.path.startswith("/debug/")):
         key = request.headers.get("X-API-Key")
         if not key:
             logger.warning("Missing X-API-Key header.")
@@ -315,13 +412,46 @@ async def auth_middleware(request: web.Request, handler):
     return await handler(request)
 
 
+def _record_engine_spans(trace: Optional[Trace], t_block0: float,
+                         t_block1: float, er: EngineResult) -> None:
+    """Reconstruct the engine block's phase timeline onto the trace.
+
+    The engine call is one awaited block from the handler's view; the
+    EngineResult carries where that time went (queue_ms / prefill_ms /
+    decode_ms as the engine measured them). They are laid back-to-back
+    from the block's start, and whatever the three phases don't account
+    for — detokenization, event-loop handoff, chunk-pipeline slack — is
+    the ``detokenize`` remainder, so the span durations always sum to the
+    block's wall time (the property the /debug/requests timeline is
+    documented to hold). The separately-measured host detok time rides
+    along as span metadata when the engine reports it.
+    """
+    if trace is None:
+        return
+    k = 1000.0
+    t_q = t_block0 + er.queue_ms / k
+    t_p = t_q + er.prefill_ms / k
+    t_d = t_p + er.decode_ms / k
+    # Clamp into the block: the engine's own spans can overrun the
+    # handler-observed wall time by scheduler jitter; never let a span
+    # escape the block it happened in.
+    t_q, t_p, t_d = (min(t, t_block1) for t in (t_q, t_p, t_d))
+    trace.add_span("queue_wait", t_block0, t_q)
+    trace.add_span("prefill", t_q, t_p)
+    trace.add_span("decode", t_p, t_d)
+    meta = {"detok_host_ms": round(er.detok_ms, 3)} if er.detok_ms else {}
+    trace.add_span("detokenize", t_d, t_block1, **meta)
+
+
 async def handle_kubectl_command(request: web.Request) -> web.Response:
     """POST /kubectl-command (reference app.py:284-346)."""
     svc: Service = request.app["service"]
+    trace: Optional[Trace] = request.get("trace")
     start_iso = utcnow_iso()
     t0 = time.monotonic()
     try:
-        q = Query.model_validate(await request.json())
+        with _span("validate"):
+            q = Query.model_validate(await request.json())
     except (ValidationError, ValueError) as e:
         return _json_error(400, f"Invalid input query: {e}")
 
@@ -330,6 +460,7 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
     if len(sanitized_query) < 3:
         return _json_error(400, "Invalid input query: too short after sanitation")
 
+    t_block0 = time.monotonic()
     try:
         command, from_cache, engine_result, degraded = await svc.generate_command(
             sanitized_query
@@ -350,7 +481,8 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
         logger.exception("Unexpected error processing query '%s'", sanitized_query)
         return _json_error(500, "Internal server error processing request")
 
-    duration_ms = (time.monotonic() - t0) * 1000.0
+    t_block1 = time.monotonic()
+    duration_ms = (t_block1 - t0) * 1000.0
     engine_md = None
     if engine_result is not None:
         # Degraded rule-table responses stay out of the engine latency /
@@ -361,14 +493,23 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
             svc.metrics.ttft.observe(engine_result.ttft_ms / 1000.0)
             svc.metrics.gen_latency.observe(duration_ms / 1000.0)
             svc.metrics.tokens_generated.inc(max(engine_result.completion_tokens, 0))
-            if engine_result.tokens_per_sec:
-                svc.metrics.tokens_per_sec.set(engine_result.tokens_per_sec)
+            # Feeds the windowed engine_tokens_per_sec gauge (read at
+            # scrape time) — the old per-request .set() only ever showed
+            # the LAST finisher and was racy under concurrent decode.
+            svc.token_rate.add(engine_result.completion_tokens)
             if engine_result.prefix_cache_hit:
                 svc.metrics.prefix_cache_hits.inc()
+            # Non-degraded engine block: lay queue/prefill/decode/detok
+            # spans over it from the engine's own measurements. A degraded
+            # block already carries its "fallback" span (plus the failure
+            # event), and a cache hit its "cache" span below.
+            if not from_cache:
+                _record_engine_spans(trace, t_block0, t_block1, engine_result)
         engine_md = EngineMetadata(
             queue_ms=engine_result.queue_ms,
             prefill_ms=engine_result.prefill_ms,
             decode_ms=engine_result.decode_ms,
+            detok_ms=engine_result.detok_ms,
             ttft_ms=engine_result.ttft_ms,
             prompt_tokens=engine_result.prompt_tokens,
             completion_tokens=engine_result.completion_tokens,
@@ -376,17 +517,24 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
             prefix_cache_hit=engine_result.prefix_cache_hit,
             engine=engine_result.engine,
         )
-
-    body = CommandResponse(
-        kubectl_command=command,
-        execution_result=None,   # generation and execution are separate (B1, deliberate)
-        execution_error=None,
-        from_cache=from_cache,
-        metadata=ExecutionMetadata(**build_metadata(start_iso, t0, True)),
-        engine_metadata=engine_md,
-        degraded=degraded,
-    )
-    return web.json_response(body.model_dump())
+    if trace is not None:
+        trace.from_cache = from_cache
+        if from_cache:
+            trace.add_span("cache", t_block0, t_block1)
+    with _span("respond"):
+        timings = trace.phase_durations() if trace is not None else None
+        body = CommandResponse(
+            kubectl_command=command,
+            execution_result=None,   # generation and execution are separate (B1, deliberate)
+            execution_error=None,
+            from_cache=from_cache,
+            metadata=ExecutionMetadata(**build_metadata(start_iso, t0, True)),
+            engine_metadata=engine_md,
+            degraded=degraded,
+            timings=timings,
+        )
+        payload = body.model_dump()
+    return web.json_response(payload)
 
 
 async def handle_kubectl_command_stream(request: web.Request) -> web.StreamResponse:
@@ -401,10 +549,16 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
     if len(sanitized_query) < 3:
         return _json_error(400, "Invalid input query: too short after sanitation")
 
+    trace: Optional[Trace] = request.get("trace")
     resp = web.StreamResponse(
         status=200,
         headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"},
     )
+    if trace is not None:
+        # Streaming commits headers at prepare() time, before any phase
+        # has run — the middleware can't stamp them afterwards. The ID is
+        # known now; Server-Timing (whose values aren't) stays JSON-only.
+        resp.headers["X-Request-ID"] = trace.request_id
     await resp.prepare(request)
 
     def sse(payload: str, event: Optional[str] = None) -> bytes:
@@ -461,7 +615,8 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
             # stays outside so an unsafe output doesn't count as an
             # engine failure.
             text = await svc.run_engine(run)
-            return parse_llm_output(text)
+            with _span("safety"):
+                return parse_llm_output(text)
         finally:
             token_q.put_nowait(_DONE)
 
@@ -489,6 +644,8 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
             if getter is not None and not getter.done():
                 getter.cancel()
         command, from_cache = await flight
+        if trace is not None:
+            trace.from_cache = from_cache
         if from_cache:
             # A cache hit or another request's in-flight generation served
             # us; our supplier never streamed — replay the result.
@@ -541,33 +698,40 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
 async def handle_execute(request: web.Request) -> web.Response:
     """POST /execute (reference app.py:356-389)."""
     svc: Service = request.app["service"]
+    trace: Optional[Trace] = request.get("trace")
     try:
-        req = ExecuteRequest.model_validate(await request.json())
+        with _span("validate"):
+            req = ExecuteRequest.model_validate(await request.json())
     except (ValidationError, ValueError) as e:
         return _json_error(400, f"Invalid request: {e}")
 
     logger.info("Received execute request for command: '%s'", req.execute)
     from .safety import unsafe_reason
 
-    reason = unsafe_reason(req.execute)
+    with _span("safety"):
+        reason = unsafe_reason(req.execute)
     if reason is not None:
         svc.metrics.unsafe_commands.labels("user").inc()
         return _json_error(400, f"Command failed safety checks: {reason}")
 
-    execution_data = await svc.executor.execute(req.execute)
+    with _span("execute"):
+        execution_data = await svc.executor.execute(req.execute)
     outcome = "success" if execution_data["metadata"]["success"] else (
         execution_data["metadata"].get("error_type") or "error"
     )
     svc.metrics.executions.labels(outcome).inc()
 
-    body = CommandResponse(
-        kubectl_command=req.execute,
-        execution_result=execution_data.get("execution_result"),
-        execution_error=execution_data.get("execution_error"),
-        from_cache=False,
-        metadata=ExecutionMetadata(**execution_data["metadata"]),
-    )
-    return web.json_response(body.model_dump())
+    with _span("respond"):
+        body = CommandResponse(
+            kubectl_command=req.execute,
+            execution_result=execution_data.get("execution_result"),
+            execution_error=execution_data.get("execution_error"),
+            from_cache=False,
+            metadata=ExecutionMetadata(**execution_data["metadata"]),
+            timings=trace.phase_durations() if trace is not None else None,
+        )
+        payload = body.model_dump()
+    return web.json_response(payload)
 
 
 def _device_count(app: web.Application) -> int:
@@ -608,48 +772,84 @@ async def handle_health(request: web.Request) -> web.Response:
     return web.json_response(body.model_dump(), status=200 if ready else 503)
 
 
-async def handle_debug_trace(request: web.Request) -> web.Response:
-    """POST /debug/trace?seconds=N — capture a jax.profiler device trace
+def _debug_forbidden(request: web.Request) -> Optional[web.Response]:
+    """Token gate for /debug/*: when DEBUG_TOKEN is configured, require a
+    matching X-Debug-Token header ON TOP of the API-key auth middleware.
+    Debug surfaces (request timelines, profiler captures) are
+    operator-facing — a leaked client API key must not open them."""
+    token = request.app["service"].cfg.debug_token
+    if not token:
+        return None
+    supplied = request.headers.get("X-Debug-Token", "")
+    # Compare bytes: compare_digest on str raises TypeError for
+    # non-ASCII input, and header values may legally carry 0x80-0xFF —
+    # a garbage token must 403, not 500.
+    if not hmac.compare_digest(
+            supplied.encode("utf-8", "surrogateescape"), token.encode()):
+        return _json_error(403, "Invalid or missing X-Debug-Token")
+    return None
+
+
+async def handle_debug_profile(request: web.Request) -> web.Response:
+    """POST /debug/profile?seconds=N — capture a jax.profiler device trace
     while live traffic runs (SURVEY.md §5 tracing row; TensorBoard-
-    loadable). Auth-gated like the serving routes; one trace at a time;
-    only the newest few captures are retained."""
+    loadable). Auth- and token-gated; one capture at a time; only the
+    newest few captures are retained (obs/profiler.py). ``/debug/trace``
+    is the pre-rename alias."""
+    denied = _debug_forbidden(request)
+    if denied is not None:
+        return denied
     try:
-        seconds = min(max(float(request.query.get("seconds", 2.0)), 0.1), 30.0)
+        seconds = obs_profiler.clamp_seconds(
+            float(request.query.get("seconds", 2.0)))
     except ValueError:
         return _json_error(400, "seconds must be a number")
     if request.app.get("_tracing"):
         return _json_error(409, "a trace is already in progress")
     request.app["_tracing"] = True
     try:
-        import os
-        import shutil
-        import tempfile
-
-        import jax
-
-        base = os.path.join(tempfile.gettempdir(),
-                            "ai-agent-kubectl-tpu-traces")
-        os.makedirs(base, exist_ok=True)
-        # Retention: traces are tens of MB; keep the newest 4 + this one.
-        old = sorted(
-            (d for d in os.listdir(base)
-             if os.path.isdir(os.path.join(base, d))),
-        )
-        for d in old[:-4] if len(old) > 4 else []:
-            shutil.rmtree(os.path.join(base, d), ignore_errors=True)
-        trace_dir = tempfile.mkdtemp(prefix=f"{time.strftime('%Y%m%d-%H%M%S')}-",
-                                     dir=base)
-        jax.profiler.start_trace(trace_dir)
-        try:
-            await asyncio.sleep(seconds)
-        finally:
-            jax.profiler.stop_trace()
+        result = await obs_profiler.capture(seconds)
     except Exception as e:  # pragma: no cover - backend-dependent
         logger.exception("trace capture failed")
         return _json_error(500, f"trace capture failed: {e}")
     finally:
         request.app["_tracing"] = False
-    return web.json_response({"trace_dir": trace_dir, "seconds": seconds})
+    return web.json_response(result)
+
+
+async def handle_debug_requests(request: web.Request) -> web.Response:
+    """GET /debug/requests — newest-first flight-recorder index (summaries
+    only; fetch a request_id's full timeline from the detail route)."""
+    denied = _debug_forbidden(request)
+    if denied is not None:
+        return denied
+    svc: Service = request.app["service"]
+    try:
+        limit = int(request.query.get("limit", 50))
+    except ValueError:
+        return _json_error(400, "limit must be an integer")
+    return web.json_response({
+        "size": svc.recorder.size,
+        "recorded": svc.recorder.recorded,
+        "requests": svc.recorder.list(limit=limit),
+    })
+
+
+async def handle_debug_request_detail(request: web.Request) -> web.Response:
+    """GET /debug/requests/{id} — one request's full span timeline."""
+    denied = _debug_forbidden(request)
+    if denied is not None:
+        return denied
+    svc: Service = request.app["service"]
+    rid = request.match_info["id"]
+    entry = svc.recorder.get(rid)
+    if entry is None:
+        return _json_error(
+            404,
+            f"request {rid!r} not in the flight recorder (keeps the last "
+            f"{svc.recorder.size}; is FLIGHT_RECORDER_SIZE large enough?)",
+        )
+    return web.json_response(entry)
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
@@ -657,12 +857,19 @@ async def handle_metrics(request: web.Request) -> web.Response:
     # Engine gauges are sampled at scrape time (live scheduler state, not a
     # push path the hot loop has to touch).
     stats_fn = getattr(svc.engine, "stats", None)
+    stats = {}
     if callable(stats_fn):
         stats = stats_fn()
         svc.metrics.batch_occupancy.set(stats.get("batch_occupancy", 0))
         svc.metrics.queue_depth.set(stats.get("queue_depth", 0))
         svc.metrics.kv_pool_used.set(stats.get("kv_pages_used", 0))
         svc.metrics.kv_pool_total.set(stats.get("kv_pages_total", 0))
+    # Windowed throughput gauge: the batcher's own scheduler-side window
+    # when it reports one (counts every finish, including streams), else
+    # the service-side window fed by the response handlers.
+    svc.metrics.tokens_per_sec.set(
+        stats.get("tokens_per_sec_window", svc.token_rate.rate())
+    )
     svc.metrics.breaker_state.set(STATE_CODES[svc.breaker.state])
     return web.Response(body=svc.metrics.render(), content_type="text/plain")
 
@@ -680,7 +887,10 @@ def create_app(cfg: ServiceConfig, engine: Engine,
     app.router.add_post("/kubectl-command", handle_kubectl_command)
     app.router.add_post("/kubectl-command/stream", handle_kubectl_command_stream)
     app.router.add_post("/execute", handle_execute)
-    app.router.add_post("/debug/trace", handle_debug_trace)
+    app.router.add_post("/debug/profile", handle_debug_profile)
+    app.router.add_post("/debug/trace", handle_debug_profile)  # pre-rename alias
+    app.router.add_get("/debug/requests", handle_debug_requests)
+    app.router.add_get("/debug/requests/{id}", handle_debug_request_detail)
     app.router.add_get("/health", handle_health)
     app.router.add_get("/metrics", handle_metrics)
     # /openapi.json + /docs — unauthenticated like the reference's

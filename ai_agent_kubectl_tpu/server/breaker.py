@@ -20,6 +20,8 @@ import time
 from collections import deque
 from typing import Callable, Deque, Optional
 
+from ..obs.trace import trace_event
+
 CLOSED = "closed"
 HALF_OPEN = "half-open"
 OPEN = "open"
@@ -82,7 +84,9 @@ class CircuitBreaker:
             return self._epoch
         if s == HALF_OPEN and not self._probe_inflight:
             self._probe_inflight = True
+            trace_event("breaker: half-open — this call is the probe")
             return self._epoch
+        trace_event(f"breaker: {s} — engine call suspended")
         return None
 
     # No side-effect-free "allow()" helper on purpose: in HALF_OPEN an
@@ -131,17 +135,21 @@ class CircuitBreaker:
             self._opened_at = now
             self._probe_inflight = False
             self._epoch += 1
+            trace_event("breaker: half-open probe failed — re-opening")
             return
         horizon = now - self.window_secs
         while self._failures and self._failures[0] <= horizon:
             self._failures.popleft()
         self._failures.append(now)
+        trace_event(f"breaker: engine failure recorded "
+                    f"({len(self._failures)}/{self.threshold} in window)")
         if self.threshold > 0 and len(self._failures) >= self.threshold:
             self._open = True
             self._opened_at = now
             self._probe_inflight = False
             self._epoch += 1
             self.opens += 1
+            trace_event("breaker: threshold reached — OPENING")
 
     # ------------------------------------------------------ observability
 

@@ -18,6 +18,8 @@ import time
 from collections import OrderedDict
 from typing import Any, Awaitable, Callable, Dict, Generic, Hashable, Optional, Tuple, TypeVar
 
+from ..obs.trace import trace_event
+
 K = TypeVar("K", bound=Hashable)
 V = TypeVar("V")
 
@@ -142,6 +144,7 @@ class CachedSingleFlight(Generic[K, V]):
         generated for them."""
         cached: Any = self.cache.get(key, _MISSING)
         if cached is not _MISSING:
+            trace_event("cache: hit")
             return cached, True
 
         async def fill() -> V:
@@ -149,5 +152,8 @@ class CachedSingleFlight(Generic[K, V]):
             self.cache.put(key, value)
             return value
 
+        coalesced = key in self.flight._inflight
+        trace_event("cache: miss — coalescing onto the in-flight generation"
+                    if coalesced else "cache: miss — starting a generation")
         value, shared = await self.flight.do(key, fill)
         return value, shared

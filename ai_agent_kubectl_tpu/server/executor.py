@@ -28,6 +28,8 @@ import shlex
 import time
 from typing import Any, Dict, List, Optional
 
+from ..obs.trace import trace_event
+
 logger = logging.getLogger(__name__)
 
 
@@ -151,6 +153,7 @@ class CommandExecutor:
             }
         args[0] = self.kubectl_binary
 
+        trace_event(f"exec: spawning kubectl ({len(args) - 1} args)")
         try:
             process = await asyncio.create_subprocess_exec(
                 *args,
@@ -178,6 +181,7 @@ class CommandExecutor:
             logger.error(
                 "Command execution timed out after %ss: %s", self.timeout, command
             )
+            trace_event(f"exec: timed out after {self.timeout:g}s; reaping")
             await self._reap(process)
             return {
                 "execution_error": {
@@ -188,6 +192,7 @@ class CommandExecutor:
                 "metadata": build_metadata(start_iso, start_ts, False, "timeout", "execution_timeout"),
             }
 
+        trace_event(f"exec: kubectl exited rc={process.returncode}")
         if process.returncode == 0:
             result_stdout = stdout.decode(errors="replace").strip()
             logger.info("Command executed successfully (%d bytes stdout)", len(result_stdout))

@@ -12,6 +12,10 @@ A dedicated ``CollectorRegistry`` per app instance keeps tests isolated
 
 from __future__ import annotations
 
+import time
+from collections import deque
+from typing import Callable, Optional
+
 from prometheus_client import (
     CollectorRegistry,
     Counter,
@@ -23,6 +27,54 @@ from prometheus_client.exposition import CONTENT_TYPE_LATEST
 
 _TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.15, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 _LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+# Phase spans skew small (sub-ms safety checks next to multi-second
+# decodes), so the phase histogram keeps finer low-end buckets.
+_PHASE_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                  1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class WindowedRate:
+    """Rolling-window event rate for the throughput gauge.
+
+    ``engine_tokens_per_sec`` used to be ``.set()`` from each finished
+    request's own throughput — so it only ever showed the LAST request
+    (whichever response handler wrote last under concurrent decode, i.e.
+    racy and meaningless at batch>1). It is now the average completion
+    rate over a trailing window: every finished generation ``add()``s its
+    token count here, and the /metrics scrape reads ``rate()``. The
+    alternative (dropping the gauge for ``rate(engine_tokens_generated_
+    total)`` in PromQL) was rejected because bench tooling and the probe
+    scripts read the gauge directly without a Prometheus server in the
+    loop; the counter remains for PromQL users who want custom windows.
+    """
+
+    def __init__(self, window_secs: float = 60.0,
+                 timer: Callable[[], float] = time.monotonic):
+        self.window_secs = window_secs
+        self._timer = timer
+        self._events: deque = deque()   # (t, count)
+
+    def add(self, count: int, now: Optional[float] = None) -> None:
+        if count <= 0:
+            return
+        now = self._timer() if now is None else now
+        self._events.append((now, count))
+        self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_secs
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+
+    def rate(self, now: Optional[float] = None) -> float:
+        """Events per second averaged over the trailing window. The
+        denominator is the full window, not the span of observed events —
+        a single burst 50 s ago reads as its amortized rate, and an idle
+        window decays to 0 instead of freezing at the last burst."""
+        now = self._timer() if now is None else now
+        self._prune(now)
+        total = sum(c for _, c in self._events)
+        return total / self.window_secs if total else 0.0
 
 
 class Metrics:
@@ -82,8 +134,12 @@ class Metrics:
         self.tokens_generated = Counter(
             "engine_tokens_generated_total", "Completion tokens produced", registry=r
         )
+        # Windowed, not last-request (see WindowedRate above): set at
+        # scrape time from the trailing-60s completion rate.
         self.tokens_per_sec = Gauge(
-            "engine_tokens_per_sec", "Decode throughput of the last request", registry=r
+            "engine_tokens_per_sec",
+            "Decode throughput averaged over the trailing 60s window",
+            registry=r,
         )
         self.batch_occupancy = Gauge(
             "engine_batch_occupancy", "Active slots in the decode batch", registry=r
@@ -117,6 +173,18 @@ class Metrics:
         self.degraded_responses = Counter(
             "degraded_responses_total",
             "Responses served by the rule-based fallback engine",
+            registry=r,
+        )
+
+        # Request-lifecycle phase attribution (obs/trace.py): where a
+        # request's wall time went. The ``phase`` label is drawn from the
+        # fixed obs.PHASES allowlist — cardinality is bounded by
+        # construction, a span with any other name is never observed here.
+        self.request_phase = Histogram(
+            "request_phase_seconds",
+            "Per-request time spent in each lifecycle phase",
+            ["phase"],
+            buckets=_PHASE_BUCKETS,
             registry=r,
         )
 

@@ -167,12 +167,68 @@ def build_openapi() -> Dict:
                 "content": {"text/plain": {"schema": {"type": "string"}}},
             }},
         }},
-        "/debug/trace": {"post": {
-            "summary": "Capture a jax.profiler trace of one generation",
+        "/debug/profile": {"post": {
+            "summary": "Capture an on-demand jax.profiler device trace "
+                       "from the live server",
+            "description": "POST /debug/profile?seconds=N (clamped to "
+                           "[0.1, 30]) starts a jax.profiler capture "
+                           "while live traffic keeps serving and returns "
+                           "the TensorBoard-loadable trace directory. "
+                           "One capture at a time (409 otherwise); the "
+                           "newest few captures are retained. Gated by "
+                           "API-key auth AND — when DEBUG_TOKEN is set — "
+                           "an X-Debug-Token header.",
             "responses": {
-                "200": {"description": "Trace summary JSON"},
+                "200": {"description": "Capture summary JSON "
+                                       "(trace_dir, seconds)"},
+                "400": _err("seconds not a number"),
                 "401": auth_err,
-                "503": _err("Engine unavailable"),
+                "403": _err("Invalid or missing X-Debug-Token (only when "
+                            "DEBUG_TOKEN is configured)"),
+                "409": _err("A capture is already in progress"),
+                "500": _err("Capture failed (backend-dependent)"),
+            },
+        }},
+        "/debug/trace": {"post": {
+            "summary": "Alias of /debug/profile (pre-rename name)",
+            "responses": {
+                "200": {"description": "Capture summary JSON"},
+                "401": auth_err,
+            },
+        }},
+        "/debug/requests": {"get": {
+            "summary": "Flight-recorder index: the last N requests' "
+                       "summaries, newest first",
+            "description": "Every serving-path request — including shed "
+                           "503s, rate-limited 429s, degraded fallbacks "
+                           "and errors — is recorded with its full span "
+                           "timeline (FLIGHT_RECORDER_SIZE ring). Quote "
+                           "a response's X-Request-ID at "
+                           "/debug/requests/{id} for the timeline. Same "
+                           "auth/token gating as /debug/profile.",
+            "responses": {
+                "200": {"description": "{size, recorded, requests: "
+                                       "[summaries]}"},
+                "401": auth_err,
+                "403": _err("Invalid or missing X-Debug-Token"),
+            },
+        }},
+        "/debug/requests/{id}": {"get": {
+            "summary": "One request's full phase-span timeline and "
+                       "event log",
+            "parameters": [{
+                "name": "id", "in": "path", "required": True,
+                "schema": {"type": "string"},
+                "description": "The request's X-Request-ID",
+            }],
+            "responses": {
+                "200": {"description": "Trace timeline: spans "
+                                       "[{phase, start_ms, end_ms, "
+                                       "duration_ms}], events, status, "
+                                       "flags"},
+                "401": auth_err,
+                "403": _err("Invalid or missing X-Debug-Token"),
+                "404": _err("Request ID not (or no longer) in the ring"),
             },
         }},
     }

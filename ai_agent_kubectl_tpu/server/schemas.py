@@ -43,6 +43,7 @@ class EngineMetadata(BaseModel):
     queue_ms: float = 0.0
     prefill_ms: float = 0.0
     decode_ms: float = 0.0
+    detok_ms: float = 0.0
     ttft_ms: float = 0.0
     prompt_tokens: int = 0
     completion_tokens: int = 0
@@ -62,6 +63,12 @@ class CommandResponse(BaseModel):
     # the real engine was failing (DEGRADED_FALLBACK + open breaker);
     # engine_metadata.engine is then "fallback-rules".
     degraded: bool = False
+    # Per-phase millisecond breakdown of this request's lifecycle
+    # (obs/trace.py) — the same numbers as the Server-Timing header and
+    # the /debug/requests/{id} timeline, inline for clients that want
+    # them without header parsing. Additive/optional: absent when no
+    # trace context was active.
+    timings: Optional[Dict[str, float]] = None
 
 
 class HealthResponse(BaseModel):

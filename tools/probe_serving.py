@@ -14,10 +14,17 @@ KV_QUANT / prefix cache / scheduler):
    that exposed the round-4 admission stagger and validated the
    burst-ramp fix).
 
+Plus an HTTP mode (``--url``) that probes a *running server* instead of
+building an engine: it fires N requests, prints each response's
+``Server-Timing`` phase breakdown (the obs/trace.py span timeline), and
+ends with a p50/p95/p99 per-phase summary table. Both modes end with the
+percentile table.
+
 Usage (on a TPU host; defaults reproduce the 7B north-star config):
     python tools/probe_serving.py
     python tools/probe_serving.py --model gemma-2b-it --dtype bfloat16 \
         --quant "" --kv-quant "" --bs 64 --max-seq 1024
+    python tools/probe_serving.py --url http://localhost:8000 --requests 32
 """
 
 from __future__ import annotations
@@ -26,13 +33,92 @@ import argparse
 import asyncio
 import sys
 import time
+from collections import defaultdict
 from pathlib import Path
+from typing import Dict, List
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def log(msg: str) -> None:
     print(msg, flush=True)
+
+
+def percentile(values: List[float], p: float) -> float:
+    """Nearest-rank percentile on a sorted copy; good enough for a probe."""
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(round(p / 100.0 * (len(vs) - 1)))))
+    return vs[idx]
+
+
+def parse_server_timing(header: str) -> Dict[str, float]:
+    """``queue_wait;dur=1.20, decode;dur=48.01`` → {phase: ms}."""
+    out: Dict[str, float] = {}
+    for part in header.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, rest = part.partition(";")
+        for attr in rest.split(";"):
+            k, _, v = attr.strip().partition("=")
+            if k == "dur":
+                try:
+                    out[name.strip()] = float(v)
+                except ValueError:
+                    pass
+    return out
+
+
+def print_phase_summary(samples: Dict[str, List[float]]) -> None:
+    """p50/p95/p99 per-phase table over every collected request."""
+    if not samples:
+        log("probe[summary]: no phase samples collected")
+        return
+    n = max(len(v) for v in samples.values())
+    log(f"probe[summary]: per-phase latency over {n} requests (ms)")
+    log(f"  {'phase':<12} {'p50':>9} {'p95':>9} {'p99':>9} {'max':>9}")
+    for phase, vals in samples.items():
+        log(f"  {phase:<12} {percentile(vals, 50):>9.1f} "
+            f"{percentile(vals, 95):>9.1f} {percentile(vals, 99):>9.1f} "
+            f"{max(vals):>9.1f}")
+
+
+async def http_probe(args) -> None:
+    """Drive a live server: per-request Server-Timing phases + summary."""
+    import aiohttp
+
+    url = args.url.rstrip("/") + "/kubectl-command"
+    headers = {}
+    if args.api_key:
+        headers["X-API-Key"] = args.api_key
+    samples: Dict[str, List[float]] = defaultdict(list)
+    sem = asyncio.Semaphore(args.concurrency)
+
+    async def one(session: "aiohttp.ClientSession", i: int) -> None:
+        query = f"list pods in namespace probe-{i}"
+        async with sem:
+            t0 = time.monotonic()
+            async with session.post(url, json={"query": query},
+                                    headers=headers) as resp:
+                await resp.read()
+                wall = (time.monotonic() - t0) * 1000.0
+                rid = resp.headers.get("X-Request-ID", "-")
+                timing = parse_server_timing(
+                    resp.headers.get("Server-Timing", ""))
+                for phase, ms in timing.items():
+                    samples[phase].append(ms)
+                samples["wall"].append(wall)
+                phases = " ".join(f"{k}={v:.1f}ms"
+                                  for k, v in timing.items())
+                log(f"probe[http {i:>3}]: {resp.status} rid={rid} "
+                    f"wall={wall:.1f}ms  {phases or '(no Server-Timing)'}")
+
+    async with aiohttp.ClientSession() as session:
+        await asyncio.gather(*[one(session, i)
+                               for i in range(args.requests)])
+    print_phase_summary(samples)
 
 
 async def main() -> None:
@@ -50,7 +136,20 @@ async def main() -> None:
                     help="chained chunk dispatches per ceiling sample")
     ap.add_argument("--pipe-depth", type=int, default=None,
                     help="override CHUNK_PIPE_DEPTH for A/B runs")
+    ap.add_argument("--url", default=None,
+                    help="probe a RUNNING server over HTTP instead of "
+                         "building an engine (reads Server-Timing phases)")
+    ap.add_argument("--requests", type=int, default=32,
+                    help="HTTP mode: number of requests to fire")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="HTTP mode: concurrent requests in flight")
+    ap.add_argument("--api-key", default=None,
+                    help="HTTP mode: X-API-Key value")
     args = ap.parse_args()
+
+    if args.url:
+        await http_probe(args)
+        return
 
     import jax
     import jax.numpy as jnp
@@ -82,6 +181,7 @@ async def main() -> None:
         f"kv_buckets={eng._kv_buckets})")
 
     # ---- burst attribution (before the ceiling probe donates state) ----
+    samples: Dict[str, List[float]] = defaultdict(list)
     for r in range(args.rounds):
         g0 = eng._group_admitted
         t0 = time.monotonic()
@@ -95,11 +195,17 @@ async def main() -> None:
         qs = sorted(x.queue_ms for x in rs)
         pf = sorted(x.prefill_ms for x in rs)
         dm = sorted(x.decode_ms for x in rs)
+        for x in rs:
+            samples["queue_wait"].append(x.queue_ms)
+            samples["prefill"].append(x.prefill_ms)
+            samples["decode"].append(x.decode_ms)
+            samples["detokenize"].append(x.detok_ms)
         log(f"probe[burst {r}]: {tot} tok in {dt:.2f}s = {tot/dt:.0f} tok/s"
             f"  groups={eng._group_admitted - g0}"
             f"  queue p50={qs[mid]:.0f}ms"
             f"  admit-wait p0/p50/p100={pf[0]:.0f}/{pf[mid]:.0f}/{pf[-1]:.0f}ms"
             f"  decode p50={dm[mid]:.0f}ms")
+    print_phase_summary(samples)
 
     # ---- decode-chunk ceiling (stops the scheduler, drives programs) ----
     await eng.stop()
