@@ -185,6 +185,19 @@ class ServiceConfig:
     # long while work is in flight (hung device dispatch), the engine is
     # marked degraded and every waiting request is failed. 0 disables.
     engine_watchdog_secs: float = 120.0     # ENGINE_WATCHDOG_SECS
+    # Cold-start grace for the watchdog: until the scheduler has consumed
+    # its first decode-pipeline entry — and while an admission (the
+    # lazy-compile site) is mid-flight — no-progress is judged against
+    # max(ENGINE_WATCHDOG_SECS, this), so a >2-minute cold 7B compile is
+    # not mis-read as a hung dispatch that degrades the engine and fails
+    # waiting slots. Steady-state hangs still trip at the watchdog value.
+    engine_startup_grace_secs: float = 900.0  # ENGINE_STARTUP_GRACE_SECS
+    # HBM budget (MB) for batched-admission scratch KV: group sizes whose
+    # kpad × suffix-depth scratch rows exceed it are dropped per shape
+    # (groups split smaller / fall back to singles). Bounds the admission
+    # transient that, with the old full-depth scratch, kept bs=64 from
+    # fitting beside 7B int8 weights. 0 = uncapped.
+    admit_scratch_mb: int = 512             # ADMIT_SCRATCH_MB
 
     # --- overload protection / failure containment ---
     # Bounded admission: the batcher sheds work with a fast 503 +
@@ -296,6 +309,9 @@ class ServiceConfig:
             kv_page_size=_env_int("KV_PAGE_SIZE", 16),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
             engine_watchdog_secs=_env_float("ENGINE_WATCHDOG_SECS", 120.0),
+            engine_startup_grace_secs=_env_float(
+                "ENGINE_STARTUP_GRACE_SECS", 900.0),
+            admit_scratch_mb=_env_int("ADMIT_SCRATCH_MB", 512),
             max_queue_depth=_env_int("MAX_QUEUE_DEPTH", 64),
             max_inflight_requests=_env_int("MAX_INFLIGHT_REQUESTS", 256),
             degraded_fallback=_env_bool("DEGRADED_FALLBACK", False),

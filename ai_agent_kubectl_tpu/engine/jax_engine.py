@@ -549,9 +549,12 @@ class JaxEngine:
                                 v=kv_prefix_trim(cache.v, P))
 
         def splice_prefix(cache, pk, pv):
-            k = kv_update_slice(cache.k, pk)
-            v = kv_update_slice(cache.v, pv)
-            lengths = jnp.full_like(cache.lengths, kv_tokens(pk))
+            # named_scope: the decode-step/TTFT attribution (obs/
+            # attribution.py) bills this dispatch as kv_write_splice.
+            with jax.named_scope("kv_splice"):
+                k = kv_update_slice(cache.k, pk)
+                v = kv_update_slice(cache.v, pv)
+                lengths = jnp.full_like(cache.lengths, kv_tokens(pk))
             return KVCache(k=k, v=v, lengths=lengths)
 
         self._splice_prefix_fn = jax.jit(splice_prefix, donate_argnums=(0,))

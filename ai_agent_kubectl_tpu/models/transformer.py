@@ -189,16 +189,26 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
            positions: jnp.ndarray, kv_limit: int,
            batch_idx: jnp.ndarray,
            token_mask) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One transformer block. Returns (h_out, new_layer_k, new_layer_v)."""
+    """One transformer block. Returns (h_out, new_layer_k, new_layer_v).
+
+    The ``jax.named_scope`` blocks here (and in ``forward``/sampling) are
+    zero-cost HLO metadata: XLA stamps each op's ``op_name`` with the
+    scope path, which the profiler trace exports — the decode-step
+    attribution tool (obs/attribution.py) bills device spans to op
+    categories by these names instead of guessing from HLO op types.
+    """
     B, S, d = h.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-    x = rms_norm(h, lp["attn_norm"], cfg.rms_eps, cfg.rms_offset)
-    q = qmatmul(x, lp["wq"]).reshape(B, S, H, hd)
-    k = qmatmul(x, lp["wk"]).reshape(B, S, KV, hd)
-    v = qmatmul(x, lp["wv"]).reshape(B, S, KV, hd)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    with jax.named_scope("attn_norm"):
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_eps, cfg.rms_offset)
+    with jax.named_scope("qkv_proj"):
+        q = qmatmul(x, lp["wq"]).reshape(B, S, H, hd)
+        k = qmatmul(x, lp["wk"]).reshape(B, S, KV, hd)
+        v = qmatmul(x, lp["wv"]).reshape(B, S, KV, hd)
+    with jax.named_scope("rope"):
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
 
     # Write this chunk's K/V into the cache at its absolute positions.
     # (scatter; positions are per-slot absolute indices)
@@ -210,40 +220,47 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
         # context (half the decode-attention traffic, half the pool) and
         # no dequantized copy ever materializes. The fresh chunk's own
         # k/v stay bf16 for the ring path.
-        qk, qv = kv_quantize(k), kv_quantize(v)
-        layer_k = QuantKV(q=layer_k.q.at[batch_idx, positions].set(qk.q),
-                          s=layer_k.s.at[batch_idx, positions].set(qk.s))
-        layer_v = QuantKV(q=layer_v.q.at[batch_idx, positions].set(qv.q),
-                          s=layer_v.s.at[batch_idx, positions].set(qv.s))
+        with jax.named_scope("kv_write"):
+            qk, qv = kv_quantize(k), kv_quantize(v)
+            layer_k = QuantKV(q=layer_k.q.at[batch_idx, positions].set(qk.q),
+                              s=layer_k.s.at[batch_idx, positions].set(qk.s))
+            layer_v = QuantKV(q=layer_v.q.at[batch_idx, positions].set(qv.q),
+                              s=layer_v.s.at[batch_idx, positions].set(qv.s))
         if attn_impl == "paged" and S == 1:
             raise NotImplementedError(
                 "paged decode attention does not read int8 KV; the engine "
                 "resolves KV_QUANT=int8 to the dense KV ladder")
-        if attn_impl == "ring" and S > 1:
-            # Ring prefill attends over the chunk's own fresh bf16 k/v
-            # (no prior cache context); the quantized write above still
-            # lands every position for later decode.
-            from ..parallel.ring_attention import ring_attention
+        with jax.named_scope("attention"):
+            if attn_impl == "ring" and S > 1:
+                # Ring prefill attends over the chunk's own fresh bf16 k/v
+                # (no prior cache context); the quantized write above still
+                # lands every position for later decode.
+                from ..parallel.ring_attention import ring_attention
 
-            attn = ring_attention(q, k, v, positions, mesh)
-        else:
-            kv_pos = jnp.arange(kv_limit)[None, None, :]
-            mask = kv_pos <= positions[:, :, None]
-            attn = dense_attention_quant(
-                q,
-                layer_k.q[:, :kv_limit], layer_k.s[:, :kv_limit],
-                layer_v.q[:, :kv_limit], layer_v.s[:, :kv_limit],
-                mask,
-            )
-        h = h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
+                attn = ring_attention(q, k, v, positions, mesh)
+            else:
+                kv_pos = jnp.arange(kv_limit)[None, None, :]
+                mask = kv_pos <= positions[:, :, None]
+                attn = dense_attention_quant(
+                    q,
+                    layer_k.q[:, :kv_limit], layer_k.s[:, :kv_limit],
+                    layer_v.q[:, :kv_limit], layer_v.s[:, :kv_limit],
+                    mask,
+                )
+        with jax.named_scope("o_proj"):
+            h = h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
 
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
-        mlp = (_moe_mlp(cfg, lp, x, mesh, token_mask, moe_impl)
-               if cfg.is_moe else _dense_mlp(cfg, lp, x))
+        with jax.named_scope("mlp"):
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
+            mlp = (_moe_mlp(cfg, lp, x, mesh, token_mask, moe_impl)
+                   if cfg.is_moe else _dense_mlp(cfg, lp, x))
         return h + mlp, layer_k, layer_v
     else:
-        layer_k = layer_k.at[batch_idx, positions].set(k.astype(layer_k.dtype))
-        layer_v = layer_v.at[batch_idx, positions].set(v.astype(layer_v.dtype))
+        with jax.named_scope("kv_write"):
+            layer_k = layer_k.at[batch_idx, positions].set(
+                k.astype(layer_k.dtype))
+            layer_v = layer_v.at[batch_idx, positions].set(
+                v.astype(layer_v.dtype))
         k_ctx = layer_k[:, :kv_limit]
         v_ctx = layer_v[:, :kv_limit]
     # Causal mask over absolute positions (padding queries read garbage but
@@ -288,20 +305,26 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
                 q_ax, kv_ax = "model", None
             else:
                 q_ax, kv_ax = None, None
-            attn = jax.shard_map(
-                _paged, mesh=mesh,
-                in_specs=(P_(d_ax, q_ax, None),
-                          P_(d_ax, None, kv_ax, None),
-                          P_(d_ax, None, kv_ax, None),
-                          P_(d_ax)),
-                out_specs=P_(d_ax, q_ax, None),
-                axis_names={"data", "model"},
-                # pallas_call can't express per-axis varying metadata for
-                # the VMA checker; the specs above are the contract.
-                check_vma=False,
-            )(q[:, 0], layer_k, layer_v, positions[:, 0])[:, None]
+            from ..parallel.compat import shard_map
+
+            with jax.named_scope("attention"):
+                attn = shard_map(
+                    _paged, mesh=mesh,
+                    in_specs=(P_(d_ax, q_ax, None),
+                              P_(d_ax, None, kv_ax, None),
+                              P_(d_ax, None, kv_ax, None),
+                              P_(d_ax)),
+                    out_specs=P_(d_ax, q_ax, None),
+                    axis_names={"data", "model"},
+                    # pallas_call can't express per-axis varying metadata
+                    # for the VMA checker; the specs above are the
+                    # contract.
+                    check_vma=False,
+                )(q[:, 0], layer_k, layer_v, positions[:, 0])[:, None]
         else:
-            attn = _paged(q[:, 0], layer_k, layer_v, positions[:, 0])[:, None]
+            with jax.named_scope("attention"):
+                attn = _paged(q[:, 0], layer_k, layer_v,
+                              positions[:, 0])[:, None]
     elif attn_impl == "ring" and S > 1:
         # Sequence-parallel self-attention over the chunk itself (no prior
         # cache context) — the from-scratch long-prefill path. K/V blocks
@@ -309,18 +332,23 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
         # above still lands every position for later decode.
         from ..parallel.ring_attention import ring_attention
 
-        attn = ring_attention(q, k, v, positions, mesh)
+        with jax.named_scope("attention"):
+            attn = ring_attention(q, k, v, positions, mesh)
     elif attn_impl == "flash" and S > 1:
         from ..ops.flash_attention import flash_attention_cached
 
-        attn = flash_attention_cached(q, k_ctx, v_ctx, positions)
+        with jax.named_scope("attention"):
+            attn = flash_attention_cached(q, k_ctx, v_ctx, positions)
     else:
-        attn = dense_attention(q, k_ctx, v_ctx, mask)
-    h = h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
+        with jax.named_scope("attention"):
+            attn = dense_attention(q, k_ctx, v_ctx, mask)
+    with jax.named_scope("o_proj"):
+        h = h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
 
-    x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
-    mlp = (_moe_mlp(cfg, lp, x, mesh, token_mask, moe_impl) if cfg.is_moe
-           else _dense_mlp(cfg, lp, x))
+    with jax.named_scope("mlp"):
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
+        mlp = (_moe_mlp(cfg, lp, x, mesh, token_mask, moe_impl) if cfg.is_moe
+               else _dense_mlp(cfg, lp, x))
     return h + mlp, layer_k, layer_v
 
 
@@ -366,10 +394,11 @@ def forward(
 
     # final_norm is always a plain array in the model dtype — it anchors
     # the activation dtype when the embedding is stored int8.
-    h = embed_lookup(params["embed"], tokens,
-                     dtype=params["final_norm"].dtype)
-    if cfg.embed_scale:
-        h = h * jnp.asarray(cfg.dim ** 0.5, h.dtype)
+    with jax.named_scope("embed"):
+        h = embed_lookup(params["embed"], tokens,
+                         dtype=params["final_norm"].dtype)
+        if cfg.embed_scale:
+            h = h * jnp.asarray(cfg.dim ** 0.5, h.dtype)
 
     if mesh is not None and "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
         # Pipeline-parallel serving: the layer stack (params and KV cache
@@ -403,13 +432,15 @@ def forward(
             scan_body, h, (params["layers"], cache.k, cache.v)
         )
 
-    h = rms_norm(h, params["final_norm"], cfg.rms_eps, cfg.rms_offset)
+    with jax.named_scope("final_norm"):
+        h = rms_norm(h, params["final_norm"], cfg.rms_eps, cfg.rms_offset)
     if logits_at is not None:
         h = h[jnp.arange(B), logits_at][:, None]       # [B, 1, D]
-    if cfg.tie_embeddings:
-        logits = tied_head(h, params["embed"])
-    else:
-        logits = qmatmul(h, params["lm_head"])
+    with jax.named_scope("lm_head"):
+        if cfg.tie_embeddings:
+            logits = tied_head(h, params["embed"])
+        else:
+            logits = qmatmul(h, params["lm_head"])
 
     new_lengths = jnp.maximum(cache.lengths, positions.max(axis=1) + 1)
     return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v, lengths=new_lengths)

@@ -51,27 +51,36 @@ def dense_attention(
     under the f32 test configs everything stays f32, preserving the
     reference numerics the kernels are validated against. Softmax and
     masking stay f32 always.
-    """
-    n_heads = q.shape[2]
-    n_kv = k.shape[2]
-    k = repeat_kv(k, n_heads // n_kv)
-    v = repeat_kv(v, n_heads // n_kv)
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
 
+    GQA/MQA group queries instead of repeating KV (round 6, same
+    structure ``dense_attention_quant`` proved in r5): queries reshape to
+    [b, q, n_kv, g, d] and both dots contract against the UNREPEATED KV
+    span — ``repeat_kv``'s broadcast+reshape is a materialization XLA
+    cannot always fuse away, which on the MQA 2B headline model read the
+    whole span ×8 (one per query head) per layer per decode step. The
+    per-head math is unchanged (each grouped query row contracts the
+    same KV vectors the repeated layout would have).
+    """
+    B, Q, H, D = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    if scale is None:
+        scale = D ** -0.5
+    qg = q.reshape(B, Q, KV, G, D)
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k.astype(q.dtype),
+        "bqkgd,bskd->bkgqs", qg, k.astype(q.dtype),
         preferred_element_type=jnp.float32,
     ) * scale
     if logit_softcap > 0.0:
         logits = jnp.tanh(logits / logit_softcap) * logit_softcap
     if mask is not None:
-        logits = jnp.where(mask[:, None, :, :], logits, -1e30)
+        logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype),
-                     v.astype(q.dtype),
-                     preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    out = jnp.einsum(
+        "bkgqs,bskd->bqkgd", probs.astype(q.dtype), v.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, Q, H, D).astype(q.dtype)
 
 
 def dense_attention_quant(

@@ -241,9 +241,21 @@ def kv_slot_update(dst, src, slot):
 
 def kv_set_slots(dst, src, slots):
     """Scatter per-row KV blocks into slots (axis 1); out-of-bounds rows
-    drop (the batched-admission padding contract)."""
-    return jax.tree.map(
-        lambda d, s: d.at[:, slots].set(s, mode="drop"), dst, src)
+    drop (the batched-admission padding contract).
+
+    ``src`` may be SHALLOWER than ``dst`` along the sequence axis (2):
+    group admissions prefill into suffix-depth scratch (kv_limit
+    positions, not the slot's full S_alloc — engine/batcher.py), and only
+    those positions are written. The slot's stale tail beyond src's depth
+    is never read: decode's causal mask exposes only positions below the
+    slot's live length, and each later position is overwritten by its own
+    decode step before the mask ever reaches it."""
+    def set_rows(d, s):
+        if s.shape[2] < d.shape[2]:
+            return d.at[:, slots, :s.shape[2]].set(s, mode="drop")
+        return d.at[:, slots].set(s, mode="drop")
+
+    return jax.tree.map(set_rows, dst, src)
 
 
 def kv_broadcast_rows(src, n: int):

@@ -1,0 +1,66 @@
+"""jax API-drift shims for the parallel subsystem.
+
+``jax.shard_map`` graduated out of ``jax.experimental.shard_map`` and the
+old alias was later removed; toolchains in the field span both sides of
+the rename (this repo's CI container ships 0.4.37, where only the
+experimental path exists — every mesh test fails at import-of-use
+otherwise). One wrapper, new-API keyword surface, translated for the old
+one:
+
+- ``axis_names`` (axes that are Manual) → experimental ``auto`` (axes
+  that are NOT: ``mesh.axis_names − axis_names``).
+- ``check_vma`` → experimental ``check_rep`` (same replication check,
+  renamed). When the caller didn't ask for it, the legacy path passes
+  ``check_rep=False``: the old checker predates ``pvary`` (below), so
+  bodies written against the new varying-marker API can't satisfy it.
+- ``jax.lax.pvary`` (marks a value as varying over manual axes, required
+  by the new API's replication typing) → identity on toolchains that
+  predate it; with ``check_rep=False`` the marker is advisory there.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def pvary(x, axis_name):
+    """``jax.lax.pvary`` when it exists, identity otherwise (pre-pvary
+    toolchains run the legacy shard_map with its rep check off — see
+    ``shard_map`` — so the marker has nothing to satisfy)."""
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_name)
+    return x
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None):
+    """``jax.shard_map`` when this jax has it, else the experimental
+    equivalent with translated kwargs. Positional use is deliberately not
+    supported — call sites stay explicit so both APIs read the same."""
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kw = {"check_rep": bool(check_vma)}
+    if axis_names is not None:
+        # Size-1 axes are dropped from the auto set: one shard means
+        # "automatic" and "manual" coincide, and the legacy partitioner's
+        # partial-manual mode is the buggy path on old toolchains (its
+        # SPMD pass rejects axis_index as an ambiguous PartitionId, and
+        # some stage bodies hard-abort XLA:CPU). A pipe-only serving mesh
+        # (pipe>1, everything else 1 — the single-host emulation case)
+        # therefore runs FULL-manual here, which works; a genuine
+        # partial-manual mesh (pp × tp>1) keeps the auto axes it needs.
+        auto = frozenset(a for a in mesh.axis_names
+                         if a not in frozenset(axis_names)
+                         and mesh.shape[a] > 1)
+        if auto:
+            kw["auto"] = auto
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.config import ModelConfig
+from .compat import shard_map
 
 
 def router_weights(cfg: ModelConfig, logits: jnp.ndarray):
@@ -199,7 +200,7 @@ def expert_parallel_moe(
                   qspec[2] if len(qspec) > 2 else None)
         return QuantInt8(q=qspec, scale=sspec)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ep_shard, cfg=cfg, axis=axis,
                 model_axis=model_axis if use_tp else None, capacity=capacity),
         mesh=mesh,

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import pvary, shard_map
+
 
 def _block_attention(q, k, v, qpos, kpos, scale):
     """Online-softmax partial update for one K/V block.
@@ -85,9 +87,9 @@ def _ring_shard(q, k, v, qpos, kpos, *, axis: str, scale: float):
 
     # pvary: the accumulator starts as a constant but becomes device-varying
     # after the first block — mark it so shard_map's carry typing agrees.
-    m0 = jax.lax.pvary(jnp.full((B, Sq, H, 1), -jnp.inf, jnp.float32), axis)
-    l0 = jax.lax.pvary(jnp.zeros((B, Sq, H, 1), jnp.float32), axis)
-    acc0 = jax.lax.pvary(jnp.zeros((B, Sq, H, hd), jnp.float32), axis)
+    m0 = pvary(jnp.full((B, Sq, H, 1), -jnp.inf, jnp.float32), axis)
+    l0 = pvary(jnp.zeros((B, Sq, H, 1), jnp.float32), axis)
+    acc0 = pvary(jnp.zeros((B, Sq, H, hd), jnp.float32), axis)
 
     def step(i, carry):
         m, l, acc, k, v, kpos = carry
@@ -138,7 +140,7 @@ def ring_attention(
         )
     spec4 = P(None, axis, None, None)
     spec2 = P(None, axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ring_shard, axis=axis, scale=scale),
         mesh=mesh,
         in_specs=(spec4, spec4, spec4, spec2, spec2),

@@ -1,0 +1,209 @@
+"""Decode-step attribution (ISSUE 3 tentpole): trace-parse path, span
+categorization, schema validation, and the engine-identical dryrun — the
+CI teeth that keep tools/attribute_step.py from rotting."""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from ai_agent_kubectl_tpu.obs.attribution import (
+    CATEGORIES, SCHEMA_ID, attribute_trace, categorize, render_markdown,
+    validate_attribution,
+)
+
+
+# ------------------------------------------------------------- categorize
+
+def test_categorize_scope_keywords_win_over_hlo_fallbacks():
+    # named-scope paths (the annotations in models/transformer.py et al.)
+    assert categorize("fusion.12 jit(chunk)/transformer/qkv_proj/dot") \
+        == "weight_gemms"
+    assert categorize("fusion.9 .../attention/dot_general") == "attention"
+    assert categorize("fusion.3 .../lm_head/dot_general") \
+        == "lm_head_sampling"
+    assert categorize("dynamic-update-slice.4 .../kv_write/scatter") \
+        == "kv_write_splice"
+    assert categorize("fusion.1 .../mlp/mlp_norm/reduce") \
+        == "norm_rope_residual"
+    assert categorize("fusion.2 .../rope/mul") == "norm_rope_residual"
+    assert categorize("fusion.7 .../kv_splice/dus") == "kv_write_splice"
+    # "attn_norm" must not be mistaken for attention.
+    assert categorize("fusion.5 .../attn_norm/reduce") \
+        == "norm_rope_residual"
+    # HLO fallbacks for unscoped spans
+    assert categorize("dot.42") == "weight_gemms"
+    assert categorize("copy.3") == "data_movement"
+    assert categorize("scatter.1") == "kv_write_splice"
+    assert categorize("rng_bit_generator.0") == "lm_head_sampling"
+    assert categorize("custom-call.websocket") == "other_device"
+
+
+# ------------------------------------------------------ synthetic trace dir
+
+def _write_trace(tmp_path, events):
+    run = tmp_path / "plugins" / "profile" / "run1"
+    os.makedirs(run)
+    payload = {"traceEvents": events}
+    with gzip.open(run / "host.trace.json.gz", "wt") as f:
+        json.dump(payload, f)
+    return str(tmp_path)
+
+
+def _meta(pid, name):
+    return {"ph": "M", "pid": pid, "name": "process_name",
+            "args": {"name": name}}
+
+
+def _tmeta(pid, tid, name):
+    return {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+            "args": {"name": name}}
+
+
+def _op(pid, tid, name, ts, dur, long_name=None):
+    args = {"long_name": long_name} if long_name else {}
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name, "ts": ts,
+            "dur": dur, "args": args}
+
+
+def test_attribute_trace_synthetic_tpu_stream(tmp_path):
+    """A hand-built device stream: known durations land in the right
+    categories, the hierarchical 'XLA Modules' row is NOT double-counted,
+    idle becomes gaps, and the table sums to the window."""
+    ev = [
+        _meta(7, "/device:TPU:0"),
+        _tmeta(7, 1, "XLA Ops"),
+        _tmeta(7, 2, "XLA Modules"),
+        _meta(9, "/host:CPU"),
+        _tmeta(9, 5, "python"),
+        # module row spanning everything — must be ignored (not op-level)
+        _op(7, 2, "jit_chunk", 0.0, 10_000.0),
+        # op rows: us timestamps
+        _op(7, 1, "fusion.1", 0.0, 4_000.0,
+            "jit(chunk)/transformer/mlp/dot_general"),
+        _op(7, 1, "fusion.2", 4_000.0, 2_000.0,
+            "jit(chunk)/transformer/attention/dot_general"),
+        _op(7, 1, "fusion.3", 6_000.0, 1_000.0,
+            "jit(chunk)/sampling/argmax"),
+        _op(7, 1, "dynamic-update-slice.9", 7_000.0, 500.0,
+            "jit(chunk)/transformer/kv_write/scatter"),
+        # 1.5 ms idle gap, then an unscoped copy
+        _op(7, 1, "copy.1", 9_000.0, 1_000.0),
+        # host rows must be ignored entirely when a TPU pid exists
+        _op(9, 5, "python_overhead", 0.0, 50_000.0),
+    ]
+    out = attribute_trace(_write_trace(tmp_path, ev), steps=10)
+    validate_attribution(out)
+    assert out["span_source"] == "tpu_device"
+    cats = {c["name"]: c["ms_per_step"] for c in out["categories"]}
+    assert cats["weight_gemms"] == pytest.approx(0.4)
+    assert cats["attention"] == pytest.approx(0.2)
+    assert cats["lm_head_sampling"] == pytest.approx(0.1)
+    assert cats["kv_write_splice"] == pytest.approx(0.05)
+    assert cats["data_movement"] == pytest.approx(0.1)
+    assert cats["gaps"] == pytest.approx(0.15)      # 1.5 ms idle / 10 steps
+    assert out["step_ms"] == pytest.approx(1.0)     # 10 ms window / 10
+    # coverage counts recognized categories (incl. data_movement): all but
+    # gaps here -> 85%.
+    assert out["coverage_pct"] == pytest.approx(85.0)
+    total_pct = sum(c["pct_of_step"] for c in out["categories"])
+    assert total_pct == pytest.approx(100.0, abs=0.5)
+    md = render_markdown(out)
+    assert "weight_gemms" in md and "step total" in md
+
+
+def test_attribute_trace_overlapping_categories_cap_coverage(tmp_path):
+    """Concurrent host-XLA spans in DIFFERENT recognized categories must
+    not push coverage past 100%: coverage is the union of recognized
+    intervals, not their sum (code-review r6 finding — the sum version
+    returned 200% and failed its own schema check)."""
+    ev = [
+        _meta(9, "/host:CPU"),
+        {"ph": "X", "pid": 9, "tid": 5, "name": "dot.1", "ts": 0.0,
+         "dur": 1_000.0, "args": {"hlo_op": "dot.1"}},
+        {"ph": "X", "pid": 9, "tid": 6, "name": "scatter.1", "ts": 0.0,
+         "dur": 1_000.0, "args": {"hlo_op": "scatter.1"}},
+    ]
+    out = attribute_trace(_write_trace(tmp_path, ev), steps=1)
+    validate_attribution(out)
+    assert out["coverage_pct"] == pytest.approx(100.0)
+    assert out["unattributed_ms_per_step"] == pytest.approx(0.0)
+
+
+def test_attribute_trace_host_fallback(tmp_path):
+    """With no TPU pid, host XLA op executions (hlo_op arg) are used and
+    the artifact says so."""
+    ev = [
+        _meta(9, "/host:CPU"),
+        {"ph": "X", "pid": 9, "tid": 5, "name": "dot.7", "ts": 0.0,
+         "dur": 2_000.0, "args": {"hlo_op": "dot.7", "hlo_module": "jit"}},
+    ]
+    out = attribute_trace(_write_trace(tmp_path, ev), steps=2)
+    validate_attribution(out)
+    assert out["span_source"] == "host_xla_ops"
+    cats = {c["name"]: c["ms_per_step"] for c in out["categories"]}
+    assert cats["weight_gemms"] == pytest.approx(1.0)
+
+
+# ------------------------------------------------------------------ schema
+
+def _minimal_valid():
+    cats = []
+    for name in CATEGORIES:
+        cats.append({"name": name, "ms_per_step": 0.0, "pct_of_step": 0.0,
+                     "top_ops": []})
+    return {"schema": SCHEMA_ID, "steps_measured": 1, "span_source": "none",
+            "n_device_spans": 0, "wall_ms_total": 0.0,
+            "device_busy_ms_total": 0.0, "step_ms": 0.0,
+            "device_busy_ms_per_step": 0.0, "categories": cats,
+            "coverage_pct": 0.0, "unattributed_ms_per_step": 0.0}
+
+
+def test_schema_accepts_minimal_and_rejects_mutations():
+    validate_attribution(_minimal_valid())
+    for mutate in (
+        lambda o: o.update(schema="bogus/v9"),
+        lambda o: o.update(span_source="dreams"),
+        lambda o: o.pop("coverage_pct"),
+        lambda o: o.update(coverage_pct=140.0),
+        lambda o: o["categories"].pop(0),
+        lambda o: o["categories"][0].update(name="mystery"),
+        lambda o: o["categories"][1].update(ms_per_step=-1.0),
+        lambda o: o["categories"].reverse(),
+    ):
+        bad = json.loads(json.dumps(_minimal_valid()))
+        mutate(bad)
+        with pytest.raises(ValueError):
+            validate_attribution(bad)
+
+
+def test_schema_rejects_table_that_does_not_sum_on_device():
+    obj = _minimal_valid()
+    obj["span_source"] = "tpu_device"
+    obj["wall_ms_total"] = 10.0
+    obj["categories"][0]["pct_of_step"] = 50.0     # others 0 -> sums to 50
+    with pytest.raises(ValueError):
+        validate_attribution(obj)
+
+
+# --------------------------------------------------- engine-identical chunk
+
+@pytest.mark.slow
+def test_run_attribution_toy_dryrun():
+    """The full harness on the toy model: builds the engine-identical
+    chunk, traces it, parses, validates. On CPU the spans are host XLA
+    ops — the plumbing, not the chip numbers, is what this locks in.
+    slow-marked: the tier-1 WORKFLOW runs the identical path via
+    ``tools/attribute_step.py --dryrun`` in its own step, so the CPU gate
+    still covers it without paying twice."""
+    from ai_agent_kubectl_tpu.obs.attribution import run_attribution
+
+    out = run_attribution(model="toy-8m", quant="", kv_quant="",
+                          dtype="float32", batch_size=2, chunk_len=2,
+                          max_seq=32, reps=2)
+    validate_attribution(out)
+    assert out["steps_measured"] == 4
+    assert out["model"] == "toy-8m"
+    assert out["span_source"] in ("host_xla_ops", "tpu_device")
+    assert out["n_device_spans"] > 0

@@ -1,0 +1,113 @@
+"""Decode-step cost attribution CLI (VERDICT r5 weak #1 / top_next).
+
+Runs the engine-identical donated decode chunk under ``jax.profiler.trace``
+and prints/writes the per-op-category table that must SUM to the measured
+step — weight GEMMs / attention / LM-head+sampling / KV write+splice /
+norms+RoPE / data movement / gaps — via ``obs/attribution.py`` (which
+bills device spans by the ``jax.named_scope`` annotations in
+models/transformer.py and engine/sampling.py).
+
+On the bench chip (the r5 geometry whose 33.3 ms step was ~19 ms
+unattributed):
+
+    python tools/attribute_step.py --model gemma-7b-it --quant int8 \
+        --kv-quant int8 --bs 48 --max-seq 192 --out attribution_7b.json
+
+CI runs ``--dryrun`` (toy model, CPU) so the trace-parse path and the
+artifact schema can't rot; ``--check FILE`` re-validates an existing
+artifact. On CPU the profiler exports no *device* op spans, so dryrun
+asserts plumbing + schema, not coverage; ``--require-coverage N`` is the
+on-chip acceptance gate (exit 1 below N%).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from ai_agent_kubectl_tpu.obs.attribution import (  # noqa: E402
+    render_markdown, run_attribution, validate_attribution,
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gemma-7b-it")
+    ap.add_argument("--quant", default="int8", choices=["", "int8"])
+    ap.add_argument("--kv-quant", default="int8", choices=["", "int8"])
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--bs", type=int, default=48)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--max-seq", type=int, default=192)
+    ap.add_argument("--kv-limit", type=int, default=None,
+                    help="KV bucket the chunk attends over "
+                         "(default: the serving top bucket, S_alloc)")
+    ap.add_argument("--reps", type=int, default=6,
+                    help="traced chunk executions (steps = reps x chunk)")
+    ap.add_argument("--out", default=None, help="write the JSON artifact here")
+    ap.add_argument("--keep-trace", action="store_true",
+                    help="keep the raw profiler trace dir (path in JSON)")
+    ap.add_argument("--require-coverage", type=float, default=None,
+                    help="exit 1 unless coverage_pct >= this (on-chip gate)")
+    ap.add_argument("--dryrun", action="store_true",
+                    help="toy model on whatever backend exists (CI: "
+                         "exercises trace+parse+schema, not coverage)")
+    ap.add_argument("--check", default=None, metavar="FILE",
+                    help="validate an existing artifact against the schema "
+                         "and exit (no trace run)")
+    args = ap.parse_args()
+
+    if args.check:
+        with open(args.check) as f:
+            obj = json.load(f)
+        validate_attribution(obj)
+        log(f"attribute_step: {args.check} is a valid "
+            f"{obj['schema']} artifact "
+            f"(coverage {obj['coverage_pct']:.1f}%)")
+        return 0
+
+    if args.dryrun:
+        args.model, args.quant, args.kv_quant = "toy-8m", "", ""
+        args.dtype = "float32"
+        args.bs, args.chunk, args.max_seq, args.reps = 2, 4, 64, 2
+
+    out = run_attribution(
+        model=args.model, quant=args.quant, kv_quant=args.kv_quant,
+        dtype=args.dtype, batch_size=args.bs, chunk_len=args.chunk,
+        max_seq=args.max_seq, kv_limit=args.kv_limit, reps=args.reps,
+        keep_trace=args.keep_trace,
+    )
+    validate_attribution(out)
+
+    log(f"attribute_step: {out['model']} on {out['backend']} bs={args.bs} "
+        f"chunk={args.chunk} kv_limit={out['kv_limit']} — "
+        f"step {out['step_ms']:.3f} ms (host wall "
+        f"{out['wall_ms_per_step_host']:.3f}), "
+        f"{out['n_device_spans']} device spans, "
+        f"coverage {out['coverage_pct']:.1f}%")
+    log(render_markdown(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        log(f"attribute_step: artifact -> {args.out}")
+    print(json.dumps(out), flush=True)
+
+    if (args.require_coverage is not None
+            and out["coverage_pct"] < args.require_coverage):
+        log(f"attribute_step: coverage {out['coverage_pct']:.1f}% below the "
+            f"required {args.require_coverage:.0f}% — the step is NOT "
+            f"attributed; treat the table as incomplete")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,10 +54,11 @@ async def main() -> dict:
         cfg, tokenizer=tok, model_path=args.path, dtype="bfloat16",
         quant=args.quant, kv_quant="int8", max_seq_len=args.max_seq,
         prefill_buckets=(64, 128), batch_size=args.bs, chunk_len=16,
-        # A cold 7B-scale start right after a 13-minute load can spend
-        # >120 s in one remote compile; the default watchdog would read
-        # that as a hung dispatch and degrade the engine mid-warmup.
-        watchdog_secs=900.0,
+        # DEFAULT watchdog on purpose (VERDICT r5 weak #4 regression
+        # check): the engine's own cold-start grace
+        # (ENGINE_STARTUP_GRACE_SECS, engine/batcher.py _watchdog_check)
+        # must absorb the >2-minute cold compiles a 7B-scale start pays —
+        # this tool previously had to override watchdog_secs to 900.
     )
     t0 = time.monotonic()
     await eng.start()
