@@ -155,11 +155,25 @@ class ServiceConfig:
     # coarser granularity (TTFT under load). 16 is the bench-proven value
     # (chunk 32 measured -15% throughput and 2x TTFT; BENCH_r04).
     chunk_len: int = 16                     # CHUNK_LEN
-    # Speculative decode chunks kept in flight ahead of the consumer. 2
-    # hides one fetch round trip behind one chunk of compute; 3 measured
-    # slower through the bench tunnel. Raise only for locally-attached
-    # chips with fast host links.
-    chunk_pipe_depth: int = 2               # CHUNK_PIPE_DEPTH
+    # Speculative decode chunks kept in flight ahead of the consumer.
+    # With device-side termination (the done mask in the decode chunk's
+    # carry — see DEVICE_TERMINATION) a deeper pipe no longer wastes a
+    # speculative chunk per finished request, so the default is 3: the
+    # consumer stays two fetch RTTs ahead of the device, which a ~100 ms
+    # tunnel RTT against a ~33 ms 7B chunk needs for serving throughput
+    # to track the device ceiling. Depth 2 was the old default (and
+    # remains the right choice with DEVICE_TERMINATION=false).
+    chunk_pipe_depth: int = 3               # CHUNK_PIPE_DEPTH
+    # Device-resident request termination: the decode chunk compares each
+    # sampled token against the EOS set and the per-slot max_tokens
+    # budget INSIDE the jitted scan, freezes finished slots mid-chunk
+    # (no further sampling/KV writes), and returns one packed buffer
+    # [tokens, done_mask, live_lengths, n_alive] per chunk — one fetch
+    # carries tokens AND termination, so the scheduler retires slots at
+    # consume time instead of after a host-side EOS scan. false restores
+    # the host-scan path (A/B comparisons; wasted_decode_steps_total then
+    # shows what the mask saves).
+    device_termination: bool = True         # DEVICE_TERMINATION
     prefill_buckets: str = "64,128,256,512,1024"  # PREFILL_BUCKETS (padded prefill shapes)
     temperature: float = 0.0                # TEMPERATURE (0 == greedy, matches app.py:109)
     # Sampling filters (apply when TEMPERATURE > 0): TOP_K keeps the k
@@ -298,7 +312,8 @@ class ServiceConfig:
             max_new_tokens=_env_int("MAX_NEW_TOKENS", 128),
             decode_batch_size=_env_int("DECODE_BATCH_SIZE", 8),
             chunk_len=_env_int("CHUNK_LEN", 16),
-            chunk_pipe_depth=_env_int("CHUNK_PIPE_DEPTH", 2),
+            chunk_pipe_depth=_env_int("CHUNK_PIPE_DEPTH", 3),
+            device_termination=_env_bool("DEVICE_TERMINATION", True),
             prefill_buckets=_env_str("PREFILL_BUCKETS", "64,128,256,512,1024"),
             temperature=_env_float("TEMPERATURE", 0.0),
             top_k=_env_int("TOP_K", 0),

@@ -51,8 +51,9 @@ from ..ops.quant import (kv_broadcast_rows, kv_set_slots, kv_slot_update,
                          kv_tokens, kv_update_slice)
 from .jax_engine import JaxEngine
 from .protocol import (EngineOverloaded, EngineResult, EngineUnavailable,
-                       GenerationTimeout)
-from .sampling import sample_tokens_batched
+                       GenerationTimeout, consume_chunk_row, pack_chunk,
+                       scan_chunk_row, unpack_chunk)
+from .sampling import eos_mask, sample_tokens_batched
 from .tokenizer import StreamDecoder
 
 logger = logging.getLogger(__name__)
@@ -95,6 +96,59 @@ def resolve_decode_attn(decode_attn: str, cfg, *, kv_quant: str, pipe: int,
     return "dense", page_size
 
 
+def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
+                              top_k: int, top_p: float,
+                              finalize=lambda arr: arr):
+    """Build THE device-termination decode-chunk body: a ``lax.scan`` of
+    ``chunk_len`` steps whose carry folds EOS + per-slot token budgets
+    into the live mask (finished slots stop sampling, KV writes, and
+    position advances mid-chunk) and whose result is the single packed
+    ``[tokens, done_mask, live_lengths, n_alive]`` buffer (protocol.py).
+
+    Shared by the serving engine and obs/attribution.py so "the traced
+    program IS the serving program" holds by construction, not by
+    synchronized copies. ``forward_step(params, tok, pos, cache, live)``
+    supplies the model call (the engine closes over kv_limit/mesh/attn
+    impl per KV bucket; attribution closes over its own); ``finalize``
+    post-processes the packed buffer (the engine pins it replicated
+    under a mesh)."""
+
+    def batched_chunk(params, tok, pos, cache, key, temps, force,
+                      active, ngen, budget):
+        live0 = jnp.logical_and(active, force)
+
+        def body(carry, _):
+            tok, pos, cache, key, live, ngen = carry
+            logits, cache = forward_step(params, tok, pos, cache, live)
+            key, sub = jax.random.split(key)
+            nxt = sample_tokens_batched(logits[:, 0], sub, temps,
+                                        top_k=top_k, top_p=top_p,
+                                        active=live)
+            # Termination fold — a handful of [N]-vector compares the
+            # attribution tool bills with the sampling chain.
+            with jax.named_scope("sampling"):
+                nxt = jnp.where(live, nxt, tok[:, 0])
+                hit_eos = jnp.logical_and(eos_mask(nxt, eos_ids), live)
+                counted = jnp.logical_and(live, jnp.logical_not(hit_eos))
+                ngen = ngen + counted.astype(jnp.int32)
+                done_now = jnp.logical_or(
+                    hit_eos, jnp.logical_and(counted, ngen >= budget))
+                live = jnp.logical_and(live, jnp.logical_not(done_now))
+                pos = pos + counted.astype(jnp.int32)[:, None]
+            return (nxt[:, None], pos, cache, key, live, ngen), nxt
+
+        (tok, pos, cache, key, live, ngen), toks = jax.lax.scan(
+            body, (tok, pos, cache, key, live0, ngen), None,
+            length=chunk_len)
+        toks = jnp.swapaxes(toks, 0, 1)
+        done = jnp.logical_and(force, jnp.logical_not(live))
+        packed = finalize(pack_chunk(toks, done, ngen, jnp.sum(live),
+                                     xp=jnp))
+        return packed, tok, pos, cache, key, live, ngen
+
+    return batched_chunk
+
+
 @dataclasses.dataclass
 class _Request:
     prompt_ids: List[int]
@@ -133,6 +187,9 @@ class _Slot:
     t_decode0: float = 0.0
     t_first: Optional[float] = None
     chunks_inflight: int = 0      # dispatched-but-unconsumed entries for this slot
+    decode_chunks_inflight: int = 0  # the "chunk" subset of chunks_inflight
+                                  # (waste accounting: a host-only finish
+                                  # wastes these × chunk_len device steps)
     exhausted: bool = False       # KV capacity reached; drain pipeline, then finish
     prefix_hit: bool = False      # served from the system-prompt prefix-KV cache
     detok_ms: float = 0.0         # host detokenization time, accumulated
@@ -148,8 +205,9 @@ class BatchedJaxEngine(JaxEngine):
                  watchdog_secs: float = 120.0,
                  startup_grace_secs: float = 900.0,
                  admit_scratch_mb: int = 512,
-                 chunk_pipe_depth: int = 2,
+                 chunk_pipe_depth: int = 3,
                  max_queue_depth: int = 64,
+                 device_termination: bool = True,
                  faults=None,
                  **kwargs):
         super().__init__(*args, **kwargs)
@@ -166,14 +224,25 @@ class BatchedJaxEngine(JaxEngine):
         self.batch_size = batch_size
         self.chunk_len = chunk_len
         # Speculative decode chunks kept in flight ahead of the consumer.
-        # 2 hides one fetch round trip behind one chunk of compute; depth 3
-        # was A/B-ed on the round-4 bench link and did not help (the tunnel
-        # delivers fetches in device order, so a deeper pipe only defers
-        # the first token further) while wasting one more speculative
-        # chunk on every tail. A knob (CHUNK_PIPE_DEPTH) for
-        # locally-attached chips. chunk_len=16 matches the bench-proven
-        # serving default (config.py CHUNK_LEN).
+        # Depth 2 hides one fetch round trip behind one chunk of compute;
+        # with DEVICE-side termination (the done mask in the chunk carry)
+        # deeper pipes stopped costing a wasted speculative chunk per tail
+        # — finished slots freeze inside the very chunk that finished them
+        # — so the default is now 3: the consumer stays two fetch RTTs
+        # ahead of the device, which is what the ~100 ms tunnel RTT vs
+        # ~33 ms 7B chunk needs for the serving loop to track the device
+        # ceiling. A knob (CHUNK_PIPE_DEPTH) for other link geometries.
+        # chunk_len=16 matches the bench-proven serving default
+        # (config.py CHUNK_LEN).
         self.chunk_pipe_depth = chunk_pipe_depth
+        # Device-resident termination (the tentpole of ISSUE 4): the
+        # decode chunk folds EOS + per-slot token budgets into its carried
+        # active mask, so finished slots stop sampling/KV writes
+        # mid-chunk and the packed result buffer
+        # ([tokens, done_mask, live_lengths, n_alive] — protocol.py)
+        # carries termination to the host in the SAME single fetch as the
+        # tokens. False restores the host-side EOS scan (A/B + fallback).
+        self.device_termination = device_termination
         self.kv_page_size = max(1, kv_page_size)
         self.decode_attn = decode_attn
         self.watchdog_secs = watchdog_secs
@@ -216,6 +285,22 @@ class BatchedJaxEngine(JaxEngine):
         # memory; 4096 finishes inside one window is beyond the gauge's
         # resolution needs anyway.
         self._token_finishes: collections.deque = collections.deque(maxlen=4096)
+        # Pipeline observability (ISSUE 4 satellite): cumulative decode
+        # steps executed for already-terminated slots (should sit at ~0
+        # with the device-resident done mask), chunk dispatch/consume/
+        # prune counts, fetch-latency samples (drained by the /metrics
+        # scrape into the chunk_fetch_seconds histogram), the last
+        # consumed chunk's device-reported live-slot count, and a ring of
+        # per-chunk dispatch/consume events (GET /debug/chunks). All
+        # written by the scheduler thread, read racily by scrapes — fine
+        # for gauges.
+        self._wasted_steps = 0
+        self._chunks_dispatched = 0
+        self._chunks_consumed = 0
+        self._chunks_pruned = 0
+        self._fetch_samples: collections.deque = collections.deque(maxlen=4096)
+        self._last_n_alive = 0
+        self._chunk_log: collections.deque = collections.deque(maxlen=512)
         self._admissions: _queue.Queue = _queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._running = False
@@ -273,6 +358,7 @@ class BatchedJaxEngine(JaxEngine):
             startup_grace_secs=cfg.engine_startup_grace_secs,
             admit_scratch_mb=cfg.admit_scratch_mb,
             max_queue_depth=cfg.max_queue_depth,
+            device_termination=cfg.device_termination,
             faults=faults,
         )
 
@@ -365,12 +451,47 @@ class BatchedJaxEngine(JaxEngine):
         else:
             self._kv_buckets = kv_bucket_ladder(S_alloc)
 
-        def batched_chunk(params, tok, pos, cache, key, temps, active, *,
-                          kv_limit):
-            """scan of chunk_len batched decode steps attending over
-            cache[:, :kv_limit]. Inactive slots keep their position (their
-            writes land on a frozen, dead cache slot and their tokens are
-            discarded)."""
+        eos_ids = tuple(sorted(set(cfg.eos_ids)))
+
+        def chunk_forward_step(kv_limit):
+            """The model call the shared chunk body runs per step:
+            forward over cache[:, :kv_limit] with the live mask gating
+            MoE capacity (token_mask) and the KV scatter (write_mask)."""
+
+            def step(params, tok, pos, cache, live):
+                return forward(params, cfg, tok, pos, cache,
+                               kv_limit=kv_limit,
+                               attn_impl=self._decode_impl,
+                               mesh=self.mesh,
+                               moe_impl=self.moe_impl,
+                               token_mask=live[:, None],
+                               write_mask=live,
+                               page_size=self.kv_page_size)
+
+            return step
+
+        def batched_chunk(kv_limit):
+            # The device-termination chunk body lives in
+            # make_termination_chunk_fn (module level), shared verbatim
+            # with obs/attribution.py: ``force`` is the host's view of
+            # live slots (excludes freed/exhausted), ``active``/``ngen``
+            # the device-resident carry, ``budget`` the per-slot
+            # max_tokens vector set at splice time; ONE packed buffer
+            # (pinned replicated under a mesh) returns tokens +
+            # termination + occupancy in a single fetch per chunk.
+            return make_termination_chunk_fn(
+                chunk_forward_step(kv_limit), self.chunk_len, eos_ids,
+                self.top_k, self.top_p, finalize=self._replicated)
+
+        def batched_chunk_legacy(params, tok, pos, cache, key, temps, force,
+                                 active, ngen, budget, *, kv_limit):
+            """DEVICE_TERMINATION=false: the pre-ISSUE-4 chunk body —
+            every force-live slot decodes the full chunk (finished slots
+            keep producing garbage the host discards after its EOS scan).
+            Same signature and packed-buffer contract as ``batched_chunk``
+            so the dispatch/consume plumbing is identical; the done mask
+            is all-False (the host scan decides) and live_lengths advance
+            by the full chunk."""
 
             def body(carry, _):
                 tok, pos, cache, key = carry
@@ -379,35 +500,49 @@ class BatchedJaxEngine(JaxEngine):
                                         attn_impl=self._decode_impl,
                                         mesh=self.mesh,
                                         moe_impl=self.moe_impl,
-                                        token_mask=active[:, None],
+                                        token_mask=force[:, None],
                                         page_size=self.kv_page_size)
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens_batched(logits[:, 0], sub, temps,
                                             top_k=self.top_k,
                                             top_p=self.top_p)
-                nxt = jnp.where(active, nxt, tok[:, 0])
-                pos = pos + active.astype(jnp.int32)[:, None]
+                nxt = jnp.where(force, nxt, tok[:, 0])
+                pos = pos + force.astype(jnp.int32)[:, None]
                 return (nxt[:, None], pos, cache, key), nxt
 
             (tok, pos, cache, key), toks = jax.lax.scan(
                 body, (tok, pos, cache, key), None, length=self.chunk_len
             )
-            return jnp.swapaxes(toks, 0, 1), tok, pos, cache, key
+            toks = jnp.swapaxes(toks, 0, 1)
+            ngen = ngen + force.astype(jnp.int32) * self.chunk_len
+            packed = self._replicated(
+                pack_chunk(toks, jnp.zeros_like(force), ngen,
+                           jnp.sum(force), xp=jnp))
+            return packed, tok, pos, cache, key, active, ngen
+
+        def chunk_body(kv_limit):
+            if self.device_termination:
+                return batched_chunk(kv_limit)
+            return partial(batched_chunk_legacy, kv_limit=kv_limit)
 
         # Keyed by KV bucket alone (one fixed chunk_len here) — distinct
         # from the parent's (chunk_len, kv_limit)-keyed self._chunk_fns.
         self._batch_chunk_fns = {
-            b: jax.jit(partial(batched_chunk, kv_limit=b),
-                       donate_argnums=(1, 2, 3))
+            b: jax.jit(chunk_body(b), donate_argnums=(1, 2, 3, 7, 8))
             for b in self._kv_buckets
         }
 
-        def splice(cache, src_k, src_v, tok, pos, temps,
-                   slot, n_prompt, first_tok, temperature):
+        def splice(cache, src_k, src_v, tok, pos, temps, active, ngen,
+                   budget, slot, n_prompt, first_tok, temperature,
+                   max_toks):
             """Insert a prefilled request into slot ``slot``.
             ``first_tok`` is a [1] device array — admission never reads it
             back to the host; the token value travels to the client via the
-            inflight pipeline."""
+            inflight pipeline. The termination state is armed here too:
+            the slot's budget vector entry gets the request's max_tokens,
+            its generated-count resets to 1 (the admission-sampled first
+            token), and the device-live mask arms unless the budget is
+            already spent by that first token."""
             with jax.named_scope("kv_splice"):
                 k = kv_slot_update(cache.k, src_k, slot)
                 v = kv_slot_update(cache.v, src_v, slot)
@@ -415,9 +550,14 @@ class BatchedJaxEngine(JaxEngine):
                 tok = tok.at[slot, 0].set(first_tok[0])
                 pos = pos.at[slot, 0].set(n_prompt)
                 temps = temps.at[slot].set(temperature)
-            return KVCache(k=k, v=v, lengths=lengths), tok, pos, temps
+                active = active.at[slot].set(max_toks > 1)
+                ngen = ngen.at[slot].set(1)
+                budget = budget.at[slot].set(max_toks)
+            return (KVCache(k=k, v=v, lengths=lengths), tok, pos, temps,
+                    active, ngen, budget)
 
-        self._splice_fn = jax.jit(splice, donate_argnums=(0, 3, 4, 5))
+        self._splice_fn = jax.jit(splice,
+                                  donate_argnums=(0, 3, 4, 5, 6, 7, 8))
         self._batch_admit_fns = {}   # (kind, *shape) -> jitted program
         self._batch_ready = set()    # (kpad, sbucket, kv_limit) compiled
         self._S_alloc = S_alloc
@@ -430,12 +570,23 @@ class BatchedJaxEngine(JaxEngine):
         self._tok_d = jnp.zeros((N, 1), jnp.int32)
         self._pos_d = jnp.zeros((N, 1), jnp.int32)
         self._temps_d = jnp.zeros((N,), jnp.float32)
+        # Device-resident termination state: live mask, cumulative
+        # completion-token counts, and per-slot token budgets. Carried
+        # (donated) through every chunk so a slot that finishes inside
+        # chunk N is already frozen in speculative chunks N+1.. without
+        # any host involvement; splice re-arms all three on admission.
+        self._active_d = jnp.zeros((N,), jnp.bool_)
+        self._ngen_d = jnp.zeros((N,), jnp.int32)
+        self._budget_d = jnp.ones((N,), jnp.int32)
         if self.mesh is not None:
             from ..parallel.sharding import shard_tokens
 
             self._tok_d = shard_tokens(self._tok_d, self.mesh)
             self._pos_d = shard_tokens(self._pos_d, self.mesh)
             self._temps_d = shard_tokens(self._temps_d, self.mesh)
+            self._active_d = shard_tokens(self._active_d, self.mesh)
+            self._ngen_d = shard_tokens(self._ngen_d, self.mesh)
+            self._budget_d = shard_tokens(self._budget_d, self.mesh)
         self._key_d = jax.random.PRNGKey(self.seed)
         self._slots: List[Optional[_Slot]] = [None] * N
 
@@ -453,17 +604,21 @@ class BatchedJaxEngine(JaxEngine):
             jnp.zeros((1, cfg.vocab_size), jnp.float32), self._key_d,
             jnp.asarray(0.0, jnp.float32),
         )
-        self._cache, self._tok_d, self._pos_d, self._temps_d = self._splice_fn(
+        (self._cache, self._tok_d, self._pos_d, self._temps_d,
+         self._active_d, self._ngen_d, self._budget_d) = self._splice_fn(
             self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
-            self._temps_d, jnp.asarray(0, jnp.int32),
+            self._temps_d, self._active_d, self._ngen_d, self._budget_d,
+            jnp.asarray(0, jnp.int32),
             jnp.asarray(1, jnp.int32), jnp.zeros((1,), jnp.int32),
-            jnp.asarray(0.0, jnp.float32),
+            jnp.asarray(0.0, jnp.float32), jnp.asarray(1, jnp.int32),
         )
         for kv_b in self._kv_buckets:
-            toks, self._tok_d, self._pos_d, self._cache, self._key_d = (
+            (packed, self._tok_d, self._pos_d, self._cache, self._key_d,
+             self._active_d, self._ngen_d) = (
                 self._batch_chunk_fns[kv_b](
                     self.params, self._tok_d, self._pos_d, self._cache,
-                    self._key_d, self._temps_d, jnp.zeros((N,), jnp.bool_))
+                    self._key_d, self._temps_d, jnp.zeros((N,), jnp.bool_),
+                    self._active_d, self._ngen_d, self._budget_d)
             )
         # Warm the batched-admission programs. Group scratch is allocated
         # at SUFFIX depth now — kv_limit positions (prefix + suffix bucket,
@@ -506,17 +661,21 @@ class BatchedJaxEngine(JaxEngine):
                     )
                     # All rows out-of-bounds: exercises the program, splices
                     # nothing.
-                    (self._cache, self._tok_d, self._pos_d,
-                     self._temps_d) = self._get_batch_splice_fn(kpad)(
-                        self._cache, scratch2.k, scratch2.v, self._tok_d,
-                        self._pos_d, self._temps_d,
-                        jnp.full((kpad,), N, jnp.int32),
-                        jnp.zeros((kpad,), jnp.int32), ft,
-                        jnp.zeros((kpad,), jnp.float32),
+                    (self._cache, self._tok_d, self._pos_d, self._temps_d,
+                     self._active_d, self._ngen_d, self._budget_d) = (
+                        self._get_batch_splice_fn(kpad)(
+                            self._cache, scratch2.k, scratch2.v, self._tok_d,
+                            self._pos_d, self._temps_d, self._active_d,
+                            self._ngen_d, self._budget_d,
+                            jnp.full((kpad,), N, jnp.int32),
+                            jnp.zeros((kpad,), jnp.int32), ft,
+                            jnp.zeros((kpad,), jnp.float32),
+                            jnp.ones((kpad,), jnp.int32),
+                        )
                     )
                     del scratch2
                     self._batch_ready.add((kpad, sbucket, kvl))
-        toks.block_until_ready()
+        packed.block_until_ready()
         # Non-smallest suffix buckets compile in the background; group
         # admissions for those shapes fall back to singles until then.
         self._batch_warm_thread = threading.Thread(
@@ -654,10 +813,14 @@ class BatchedJaxEngine(JaxEngine):
                 jax.ShapeDtypeStruct((N, 1), jnp.int32),
                 jax.ShapeDtypeStruct((N, 1), jnp.int32),
                 jax.ShapeDtypeStruct((N,), jnp.float32),
+                jax.ShapeDtypeStruct((N,), jnp.bool_),
+                jax.ShapeDtypeStruct((N,), jnp.int32),
+                jax.ShapeDtypeStruct((N,), jnp.int32),
                 jax.ShapeDtypeStruct((kpad,), jnp.int32),
                 jax.ShapeDtypeStruct((kpad,), jnp.int32),
                 jax.ShapeDtypeStruct((kpad,), jnp.int32),
                 jax.ShapeDtypeStruct((kpad,), jnp.float32),
+                jax.ShapeDtypeStruct((kpad,), jnp.int32),
             ).compile()
         except Exception:  # pragma: no cover - best-effort
             logger.debug("splice AOT warm failed; first group admission "
@@ -719,6 +882,16 @@ class BatchedJaxEngine(JaxEngine):
         horizon = time.monotonic() - self.TOKEN_RATE_WINDOW_SECS
         tok_window = sum(n for t, n in list(self._token_finishes)
                          if t >= horizon)
+        # Drain the fetch-latency samples accumulated since the last
+        # scrape (the /metrics handler feeds them into the
+        # chunk_fetch_seconds histogram). popleft-until-empty is safe
+        # against the scheduler thread appending concurrently.
+        fetch_samples = []
+        while True:
+            try:
+                fetch_samples.append(self._fetch_samples.popleft())
+            except IndexError:
+                break
         return {
             "batch_occupancy": sum(s is not None for s in slots),
             "queue_depth": self._admissions.qsize(),
@@ -727,6 +900,22 @@ class BatchedJaxEngine(JaxEngine):
             "queue_rejections": self._rejections,
             "max_queue_depth": self.max_queue_depth,
             "tokens_per_sec_window": tok_window / self.TOKEN_RATE_WINDOW_SECS,
+            # Decode-pipeline observability (ISSUE 4): speculative chunks
+            # currently in flight vs the configured depth, the device's
+            # own live-slot count from the last consumed chunk, wasted
+            # decode-step and chunk dispatch/consume/prune totals, and
+            # the drained fetch-latency samples.
+            "pipe_depth": self.chunk_pipe_depth,
+            "pipe_inflight": sum(
+                1 for e in list(getattr(self, "_inflight", []))
+                if e[0] == "chunk"),
+            "device_active_slots": self._last_n_alive,
+            "device_termination": self.device_termination,
+            "wasted_decode_steps": self._wasted_steps,
+            "chunks_dispatched": self._chunks_dispatched,
+            "chunks_consumed": self._chunks_consumed,
+            "chunks_pruned": self._chunks_pruned,
+            "chunk_fetch_secs": fetch_samples,
         }
 
     #: finish timestamps older than this don't feed the drain-rate
@@ -877,6 +1066,19 @@ class BatchedJaxEngine(JaxEngine):
     #: hard cap on one continuous hold (re-armed momentum can't exceed it).
     ADMIT_RAMP_SECS = 0.03
     ADMIT_RAMP_MAX_SECS = 0.12
+
+    def _replicated(self, arr):
+        """Pin an array to fully-replicated sharding under a serving mesh
+        (no-op single-device). Applied to the packed chunk buffer so the
+        host fetch reads one complete, settled copy regardless of how the
+        partitioner laid out the concat of data-sharded tokens and
+        replicated scalars."""
+        if self.mesh is None:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(self.mesh, PartitionSpec()))
 
     @property
     def admit_kpads(self) -> tuple:
@@ -1079,8 +1281,9 @@ class BatchedJaxEngine(JaxEngine):
         key = ("splice", kpad)
         fn = self._batch_admit_fns.get(key)
         if fn is None:
-            def splice_many(cache, src_k, src_v, tok, pos, temps,
-                            slots, n_prompts, first_toks, temperatures):
+            def splice_many(cache, src_k, src_v, tok, pos, temps, active,
+                            ngen, budget, slots, n_prompts, first_toks,
+                            temperatures, max_toks):
                 with jax.named_scope("kv_splice"):
                     k = kv_set_slots(cache.k, src_k, slots)
                     v = kv_set_slots(cache.v, src_v, slots)
@@ -1089,9 +1292,13 @@ class BatchedJaxEngine(JaxEngine):
                     tok = tok.at[slots, 0].set(first_toks, mode="drop")
                     pos = pos.at[slots, 0].set(n_prompts, mode="drop")
                     temps = temps.at[slots].set(temperatures, mode="drop")
-                return KVCache(k=k, v=v, lengths=lengths), tok, pos, temps
+                    active = active.at[slots].set(max_toks > 1, mode="drop")
+                    ngen = ngen.at[slots].set(1, mode="drop")
+                    budget = budget.at[slots].set(max_toks, mode="drop")
+                return (KVCache(k=k, v=v, lengths=lengths), tok, pos, temps,
+                        active, ngen, budget)
 
-            fn = jax.jit(splice_many, donate_argnums=(0, 3, 4, 5))
+            fn = jax.jit(splice_many, donate_argnums=(0, 3, 4, 5, 6, 7, 8))
             self._batch_admit_fns[key] = fn
         return fn
 
@@ -1178,12 +1385,14 @@ class BatchedJaxEngine(JaxEngine):
 
         slots_arr = np.full((kpad,), self.batch_size, np.int32)  # OOB = drop
         n_prompts = np.zeros((kpad,), np.int32)
+        budgets = np.ones((kpad,), np.int32)
         pairs = []
         for i, req in enumerate(live):
             slot_idx = self._slots.index(None)
             n_prompt = prefix.n + int(suf_lens[i])
             slots_arr[i] = slot_idx
             n_prompts[i] = n_prompt
+            budgets[i] = req.max_tokens
             self._slots[slot_idx] = _Slot(
                 req=req,
                 detok=StreamDecoder(self.tokenizer),
@@ -1201,11 +1410,14 @@ class BatchedJaxEngine(JaxEngine):
                     f"(burst of {len(live)}, suffix bucket {sbucket})")
             pairs.append((req, slot_idx))
 
-        self._cache, self._tok_d, self._pos_d, self._temps_d = (
+        (self._cache, self._tok_d, self._pos_d, self._temps_d,
+         self._active_d, self._ngen_d, self._budget_d) = (
             self._get_batch_splice_fn(kpad)(
                 self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
-                self._temps_d, jnp.asarray(slots_arr),
+                self._temps_d, self._active_d, self._ngen_d, self._budget_d,
+                jnp.asarray(slots_arr),
                 jnp.asarray(n_prompts), first_toks_d, jnp.asarray(temps),
+                jnp.asarray(budgets),
             )
         )
         self._to_host_async(first_toks_d)
@@ -1237,12 +1449,14 @@ class BatchedJaxEngine(JaxEngine):
         first_tok_d = self._sample_fn(
             last_logits, sub, jnp.asarray(req.temperature, jnp.float32)
         )
-        self._cache, self._tok_d, self._pos_d, self._temps_d = self._splice_fn(
+        (self._cache, self._tok_d, self._pos_d, self._temps_d,
+         self._active_d, self._ngen_d, self._budget_d) = self._splice_fn(
             self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
-            self._temps_d,
+            self._temps_d, self._active_d, self._ngen_d, self._budget_d,
             jnp.asarray(slot_idx, jnp.int32), jnp.asarray(n_prompt, jnp.int32),
             first_tok_d,
             jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.max_tokens, jnp.int32),
         )
 
         slot = _Slot(
@@ -1286,7 +1500,11 @@ class BatchedJaxEngine(JaxEngine):
         if req.trace is not None:
             req.trace.event("engine: first token")
         if first_tok in self.model_cfg.eos_ids:
-            self._finish(slot_idx, "stop")
+            # The device can't see a first-token EOS (the admission program
+            # samples it blind) — speculative chunks already in flight
+            # decoded this slot for nothing, which the wasted-steps
+            # counter must own up to.
+            self._finish(slot_idx, "stop", wasted_inflight=True)
             return
         t_dk = time.monotonic()
         piece = slot.detok.push(first_tok)
@@ -1307,11 +1525,12 @@ class BatchedJaxEngine(JaxEngine):
             if slot is None:
                 continue
             if slot.req.cancel.is_set():
-                self._finish(i, "abort")
+                self._finish(i, "abort", wasted_inflight=True)
             elif (slot.req.deadline is not None
                   and time.monotonic() > slot.req.deadline):
                 self._finish(i, "timeout",
-                             error=GenerationTimeout("generation timeout"))
+                             error=GenerationTimeout("generation timeout"),
+                             wasted_inflight=True)
             elif slot.pos >= self.max_seq_len:
                 slot.exhausted = True
                 if slot.chunks_inflight == 0:
@@ -1326,7 +1545,7 @@ class BatchedJaxEngine(JaxEngine):
                         if s is not None and not s.exhausted]
         if not active_slots:
             return
-        active = jnp.asarray(
+        force = jnp.asarray(
             [s is not None and not s.exhausted for s in self._slots],
             jnp.bool_,
         )
@@ -1334,13 +1553,18 @@ class BatchedJaxEngine(JaxEngine):
         # reach: decode attention cost tracks actual sequence lengths, not
         # max_seq. Buckets only grow, so recently-admitted short sequences
         # sharing a batch with a long one pay the long one's bucket — the
-        # static-shape trade, same as the active-slot masking.
+        # static-shape trade, same as the active-slot masking. ``s.pos``
+        # counts *scheduled* chunks (an upper bound: a slot the device
+        # terminated mid-chunk froze earlier), so the bucket choice and
+        # the capacity sweep stay conservative.
         needed = max(s.pos for s in active_slots) + self.chunk_len
         bucket = next(b for b in self._kv_buckets if b >= needed)
-        toks_d, self._tok_d, self._pos_d, self._cache, self._key_d = (
+        (packed_d, self._tok_d, self._pos_d, self._cache, self._key_d,
+         self._active_d, self._ngen_d) = (
             self._batch_chunk_fns[bucket](
                 self.params, self._tok_d, self._pos_d, self._cache,
-                self._key_d, self._temps_d, active)
+                self._key_d, self._temps_d, force, self._active_d,
+                self._ngen_d, self._budget_d)
         )
         snapshot = [
             s.req if s is not None and not s.exhausted else None
@@ -1349,8 +1573,15 @@ class BatchedJaxEngine(JaxEngine):
         for s in active_slots:
             s.pos += self.chunk_len
             s.chunks_inflight += 1
-        self._to_host_async(toks_d)   # overlap the transfer (see _admit_one)
-        self._inflight.append(("chunk", toks_d, snapshot))
+            s.decode_chunks_inflight += 1
+        self._to_host_async(packed_d)  # overlap the transfer (see _admit_one)
+        self._inflight.append(("chunk", packed_d, snapshot))
+        self._chunks_dispatched += 1
+        self._chunk_log.append({
+            "t": time.time(), "event": "dispatch", "kv_bucket": bucket,
+            "slots": len(active_slots),
+            "pipe": sum(1 for e in self._inflight if e[0] == "chunk"),
+        })
 
     # ----------------------------------------------------------- watchdog
 
@@ -1446,7 +1677,17 @@ class BatchedJaxEngine(JaxEngine):
             )
             if live:
                 return
-            self._inflight.pop(0)
+            entry = self._inflight.pop(0)
+            if not self.device_termination:
+                # Legacy A/B accounting: a pruned chunk still EXECUTED a
+                # full chunk of garbage for every slot it was dispatched
+                # with — the tail waste the done mask eliminates. (Device
+                # mode prices host-only finishes at _finish time instead;
+                # device-visible finishes froze inside the chunk.)
+                self._wasted_steps += sum(
+                    self.chunk_len for snap in entry[2] if snap is not None)
+            self._chunks_pruned += 1
+            self._chunk_log.append({"t": time.time(), "event": "prune"})
 
     def _consume_oldest(self) -> None:
         self._last_progress = time.monotonic()
@@ -1454,32 +1695,51 @@ class BatchedJaxEngine(JaxEngine):
         entry = self._inflight.pop(0)
         if entry[0] == "first":
             _, tok_d, req, slot_idx = entry
-            self._consume_first(int(np.asarray(tok_d)[0]), req, slot_idx)
+            self._consume_first(int(self._fetch(tok_d)[0]), req, slot_idx)
             return
         if entry[0] == "firsts":
             _, toks_d, pairs = entry
-            vals = np.asarray(toks_d)  # one fetch for the whole group
+            vals = self._fetch(toks_d)  # one fetch for the whole group
             for (req, slot_idx), v in zip(pairs, vals):
                 self._consume_first(int(v), req, slot_idx)
             return
-        _, toks_d, snapshot = entry
-        toks = np.asarray(toks_d)  # [N, chunk_len] — the per-chunk round trip
+        _, packed_d, snapshot = entry
+        # THE per-chunk round trip: tokens, done mask, live lengths, and
+        # n_alive cross in one packed buffer / one fetch (protocol.py).
+        t_fetch = time.monotonic()
+        res = unpack_chunk(self._fetch(packed_d), self.batch_size,
+                           self.chunk_len)
+        fetch_s = time.monotonic() - t_fetch
+        self._fetch_samples.append(fetch_s)
+        self._chunks_consumed += 1
+        self._last_n_alive = res.n_alive
+        self._chunk_log.append({
+            "t": time.time(), "event": "consume", "n_alive": res.n_alive,
+            "fetch_ms": round(fetch_s * 1000.0, 3),
+            "pipe": sum(1 for e in self._inflight if e[0] == "chunk"),
+        })
         cfg = self.model_cfg
         for i, slot in enumerate(self._slots):
             if slot is None or slot.req is not snapshot[i]:
-                continue  # slot freed/reassigned since this chunk launched
+                # Slot freed/reassigned since this chunk launched. Under
+                # host-side termination the device decoded the full chunk
+                # for it — that is the waste the done mask removes (under
+                # device termination the carry mask froze the slot, and
+                # host-only finishes are priced at _finish time instead).
+                if snapshot[i] is not None and not self.device_termination:
+                    self._wasted_steps += self.chunk_len
+                continue
             slot.chunks_inflight -= 1
-            new_ids = []
-            finish = None
-            for tid in toks[i]:
-                tid = int(tid)
-                if tid in cfg.eos_ids:
-                    finish = "stop"
-                    break
-                new_ids.append(tid)
-                if len(slot.detok.ids) + len(new_ids) >= slot.req.max_tokens:
-                    finish = "length"
-                    break
+            slot.decode_chunks_inflight -= 1
+            if self.device_termination:
+                new_ids, finish = consume_chunk_row(
+                    res.tokens[i], bool(res.done[i]), int(res.lengths[i]),
+                    len(slot.detok.ids), self.chunk_len, cfg.eos_ids)
+            else:
+                new_ids, finish, wasted = scan_chunk_row(
+                    res.tokens[i], len(slot.detok.ids), cfg.eos_ids,
+                    slot.req.max_tokens)
+                self._wasted_steps += wasted
             if new_ids:
                 if slot.t_first is None:
                     slot.t_first = time.monotonic()
@@ -1488,15 +1748,39 @@ class BatchedJaxEngine(JaxEngine):
                 slot.detok_ms += (time.monotonic() - t_dk) * 1000.0
                 if piece is not None:
                     self._emit(slot.req, "token", piece)
+            if slot.req.trace is not None:
+                slot.req.trace.event(
+                    f"engine: chunk consumed (+{len(new_ids)} tok"
+                    f"{', done' if finish else ''}, "
+                    f"n_alive={res.n_alive})")
             if finish is not None:
                 self._finish(i, finish)
 
     def _finish(self, slot_idx: int, finish: str,
-                error: Optional[BaseException] = None) -> None:
+                error: Optional[BaseException] = None,
+                wasted_inflight: bool = False) -> None:
         slot = self._slots[slot_idx]
         self._slots[slot_idx] = None
         if slot is None:  # pragma: no cover - defensive
             return
+        # Host-ONLY finishes (cancel/timeout/first-token EOS) end a slot
+        # the device still believes is live: every already-dispatched
+        # chunk decodes it to no purpose. Device-visible finishes (EOS /
+        # budget in the chunk carry) froze the slot inside the chunk, so
+        # they never land here. Legacy host-termination mode prices this
+        # at consume time (snapshot mismatch / prune) instead — counting
+        # both would double-bill. The bill is capped by the slot's
+        # remaining token budget: the device can never execute more
+        # counted steps than that (it freezes at the budget), so a
+        # disconnect near natural completion doesn't read as a full
+        # pipe_depth × chunk_len of waste. (A device EOS sitting in a
+        # still-unconsumed chunk can still overstate modestly — the host
+        # can't see it without the fetch it is skipping.)
+        if (wasted_inflight and self.device_termination
+                and slot.decode_chunks_inflight > 0):
+            remaining = max(0, slot.req.max_tokens - len(slot.detok.ids))
+            self._wasted_steps += min(
+                slot.decode_chunks_inflight * self.chunk_len, remaining)
         # Any finish frees a slot — errors included — so all of them feed
         # the drain-rate estimate behind retry_after_hint().
         self._finish_times.append(time.monotonic())

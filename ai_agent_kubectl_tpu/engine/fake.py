@@ -3,15 +3,31 @@
 Maps a handful of natural-language patterns to canned kubectl commands and
 supports scripted responses/latency/failures so API tests can exercise every
 status code without a TPU or network.
+
+``FakeChunkedEngine`` (further down) is the decode-PIPELINE fake: a pure-
+numpy twin of the batcher's chunked scheduler that serves deterministic
+token streams through the SAME packed-chunk contract
+(protocol.pack_chunk/unpack_chunk/consume_chunk_row) and a
+CHUNK_PIPE_DEPTH-deep speculative pipeline — so depth sweeps, device-side
+termination semantics, wasted-step accounting, and disconnect aborts are
+testable in milliseconds, without a jax engine start.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import AsyncIterator, Dict, List, Optional
+import dataclasses
+import time
+import zlib
+from collections import deque
+from typing import AsyncIterator, Callable, Dict, List, Optional
+
+import numpy as np
 
 from .fallback import extract_query, rule_command  # rules promoted there
-from .protocol import EngineResult, EngineUnavailable, GenerationTimeout
+from .protocol import (EngineResult, EngineUnavailable, GenerationTimeout,
+                       consume_chunk_row, pack_chunk, scan_chunk_row,
+                       unpack_chunk)
 
 
 class FakeEngine:
@@ -89,3 +105,391 @@ class FakeEngine:
         )
         for i, word in enumerate(result.text.split(" ")):
             yield word if i == 0 else " " + word
+
+
+# ---------------------------------------------------------------------------
+# FakeChunkedEngine — the decode-pipeline fake
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _FakeReq:
+    prompt: str
+    max_tokens: int
+    deadline: Optional[float]
+    out_queue: asyncio.Queue
+    cancel: asyncio.Event
+    stream: List[int]             # scripted token ids (ends in EOS)
+
+
+@dataclasses.dataclass
+class _FakeSlot:
+    req: _FakeReq
+    emitted: List[int]            # host-consumed completion tokens
+    dev_idx: int                  # device cursor into the stream
+    dev_ngen: int                 # device cumulative completion count
+    dev_active: bool              # device-resident live mask entry
+    last_tok: int                 # device carry token (garbage repeats)
+    decode_chunks_inflight: int = 0
+
+
+class FakeChunkedEngine:
+    """Numpy twin of ``BatchedJaxEngine``'s packed-chunk pipeline.
+
+    The "device" is a scripted next-token stream per request (derived
+    deterministically from the prompt unless ``stream_fn`` overrides it);
+    dispatching a chunk advances device-side state speculatively exactly
+    like the donated jax buffers do, packs the result through
+    ``protocol.pack_chunk``, and the consume path runs the SAME
+    ``consume_chunk_row`` / ``scan_chunk_row`` the real scheduler runs —
+    identical termination semantics by construction, which is what makes
+    the depth-sweep and done-mask parity suites meaningful.
+    """
+
+    name = "fake-chunked"
+
+    def __init__(self, *, batch_size: int = 4, chunk_len: int = 4,
+                 chunk_pipe_depth: int = 3, eos_ids=(2,),
+                 device_termination: bool = True,
+                 stream_fn: Optional[Callable[[str], List[int]]] = None):
+        if chunk_pipe_depth < 1:
+            raise ValueError("chunk_pipe_depth must be >= 1")
+        self.batch_size = batch_size
+        self.chunk_len = chunk_len
+        self.chunk_pipe_depth = chunk_pipe_depth
+        self.eos_ids = tuple(eos_ids)
+        self.device_termination = device_termination
+        self.stream_fn = stream_fn or self._default_stream
+        self._ready = False
+        self._slots: List[Optional[_FakeSlot]] = [None] * batch_size
+        self._inflight: List[tuple] = []   # ("chunk", packed, snapshot)
+        self._queue: deque = deque()
+        self._task: Optional[asyncio.Task] = None
+        # Mirrors of the batcher's pipeline counters (stats() parity).
+        self._wasted_steps = 0
+        self._fetches = 0
+        self._chunks_dispatched = 0
+        self._chunks_consumed = 0
+        self._chunks_pruned = 0
+        self._last_n_alive = 0
+
+    # ----------------------------------------------------------- streams
+
+    def _default_stream(self, prompt: str) -> List[int]:
+        """Deterministic ragged stream: 3-25 tokens drawn from a crc32
+        keystream (values kept clear of the EOS ids), EOS-terminated."""
+        h = zlib.crc32(prompt.encode())
+        n = 3 + h % 23
+        lo = max(self.eos_ids) + 1
+        return [lo + ((h >> (i % 24)) + 7 * i) % 211
+                for i in range(n)] + [self.eos_ids[0]]
+
+    def _stream_at(self, stream: List[int], idx: int) -> int:
+        """Past-the-end reads repeat EOS — the 'garbage' a real model
+        decodes after termination collapses to EOS here, which the legacy
+        host scan treats exactly like the jax engine treats its garbage
+        (discarded after the terminating token)."""
+        return stream[idx] if idx < len(stream) else self.eos_ids[0]
+
+    # ---------------------------------------------------------- lifecycle
+
+    @property
+    def ready(self) -> bool:
+        return self._ready
+
+    async def start(self) -> None:
+        self._ready = True
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self, drain_secs: float = 0.0) -> None:
+        if drain_secs > 0:
+            deadline = time.monotonic() + drain_secs
+            self._ready = False     # no new admissions
+            while time.monotonic() < deadline:
+                if not (self._queue or self._inflight
+                        or any(self._slots)):
+                    break
+                await asyncio.sleep(0.01)
+        self._ready = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        for slot in self._slots:
+            if slot is not None:
+                slot.req.out_queue.put_nowait(
+                    ("error", EngineUnavailable("engine stopped")))
+        self._slots = [None] * self.batch_size
+        while self._queue:
+            req = self._queue.popleft()
+            req.out_queue.put_nowait(
+                ("error", EngineUnavailable("engine stopped")))
+        self._inflight.clear()
+
+    def stats(self) -> dict:
+        return {
+            "batch_occupancy": sum(s is not None for s in self._slots),
+            "queue_depth": len(self._queue),
+            "pipe_depth": self.chunk_pipe_depth,
+            "pipe_inflight": len(self._inflight),
+            "device_active_slots": self._last_n_alive,
+            "device_termination": self.device_termination,
+            "wasted_decode_steps": self._wasted_steps,
+            "chunks_dispatched": self._chunks_dispatched,
+            "chunks_consumed": self._chunks_consumed,
+            "chunks_pruned": self._chunks_pruned,
+            "fetches": self._fetches,
+        }
+
+    # ---------------------------------------------------------- scheduler
+
+    async def _loop(self) -> None:
+        while True:
+            progressed = self._tick()
+            await asyncio.sleep(0 if progressed else 0.001)
+
+    def _tick(self) -> bool:
+        self._sweep()
+        self._admit_pending()
+        self._prune_dead_chunks()
+        n_active = sum(s is not None for s in self._slots)
+        if n_active and len(self._inflight) < self.chunk_pipe_depth:
+            self._dispatch_chunk()
+            return True
+        if self._inflight:
+            self._consume_oldest()
+            return True
+        return False
+
+    def _sweep(self) -> None:
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if slot.req.cancel.is_set():
+                self._finish(i, "abort", wasted_inflight=True)
+            elif (slot.req.deadline is not None
+                  and time.monotonic() > slot.req.deadline):
+                self._finish(i, "timeout",
+                             error=GenerationTimeout("generation timeout"),
+                             wasted_inflight=True)
+
+    def _admit_pending(self) -> None:
+        while self._queue and None in self._slots:
+            req = self._queue.popleft()
+            if req.cancel.is_set():
+                continue
+            i = self._slots.index(None)
+            # Admission "prefill": the stream's first token is emitted
+            # immediately (the batcher pipelines it as a "first" entry;
+            # collapsing that here keeps the fake synchronous without
+            # changing chunk semantics).
+            first = req.stream[0]
+            if first in self.eos_ids:
+                req.out_queue.put_nowait(("done", self._result(req, [], "stop")))
+                continue
+            slot = _FakeSlot(req=req, emitted=[first], dev_idx=1,
+                             dev_ngen=1, dev_active=req.max_tokens > 1,
+                             last_tok=first)
+            if not self.device_termination:
+                slot.dev_active = True
+            self._slots[i] = slot
+            req.out_queue.put_nowait(("token", self._piece([first], 0)))
+            if req.max_tokens <= 1:
+                self._finish(i, "length")
+
+    def _dispatch_chunk(self) -> None:
+        """The 'device': advance every live slot's stream cursor by up to
+        chunk_len steps, folding EOS/budget termination into the live
+        mask exactly like the jitted scan does, and pack one buffer."""
+        N, C = self.batch_size, self.chunk_len
+        toks = np.zeros((N, C), np.int32)
+        done = np.zeros((N,), bool)
+        lengths = np.zeros((N,), np.int32)
+        snapshot: List[Optional[_FakeReq]] = [None] * N
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            snapshot[i] = slot.req
+            slot.decode_chunks_inflight += 1
+            live = slot.dev_active
+            for step in range(C):
+                if self.device_termination:
+                    if not live:
+                        toks[i, step] = slot.last_tok
+                        continue
+                    nxt = self._stream_at(slot.req.stream, slot.dev_idx)
+                    toks[i, step] = nxt
+                    slot.last_tok = nxt
+                    if nxt in self.eos_ids:
+                        live = False
+                        continue
+                    slot.dev_idx += 1
+                    slot.dev_ngen += 1
+                    if slot.dev_ngen >= slot.req.max_tokens:
+                        live = False
+                else:
+                    # Legacy: the device decodes the full chunk blind.
+                    nxt = self._stream_at(slot.req.stream, slot.dev_idx)
+                    toks[i, step] = nxt
+                    slot.last_tok = nxt
+                    slot.dev_idx += 1
+                    slot.dev_ngen += 1
+            if self.device_termination:
+                done[i] = not live
+                slot.dev_active = live
+            lengths[i] = slot.dev_ngen
+        n_alive = sum(
+            1 for s in self._slots if s is not None and s.dev_active
+        ) if self.device_termination else sum(
+            s is not None for s in self._slots)
+        packed = pack_chunk(toks, done, lengths, n_alive)
+        self._inflight.append(("chunk", packed, snapshot))
+        self._chunks_dispatched += 1
+
+    def _prune_dead_chunks(self) -> None:
+        while self._inflight:
+            _, _, snapshot = self._inflight[0]
+            live = any(
+                snap is not None and self._slots[i] is not None
+                and self._slots[i].req is snap
+                for i, snap in enumerate(snapshot)
+            )
+            if live:
+                return
+            entry = self._inflight.pop(0)
+            if not self.device_termination:
+                # Mirror the batcher: pruned legacy chunks executed a full
+                # chunk of garbage per dispatched slot.
+                self._wasted_steps += sum(
+                    self.chunk_len for snap in entry[2] if snap is not None)
+            self._chunks_pruned += 1
+
+    def _consume_oldest(self) -> None:
+        _, packed, snapshot = self._inflight.pop(0)
+        self._fetches += 1          # the single fetch per chunk
+        res = unpack_chunk(packed, self.batch_size, self.chunk_len)
+        self._chunks_consumed += 1
+        self._last_n_alive = res.n_alive
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.req is not snapshot[i]:
+                if snapshot[i] is not None and not self.device_termination:
+                    self._wasted_steps += self.chunk_len
+                continue
+            slot.decode_chunks_inflight -= 1
+            if self.device_termination:
+                new_ids, finish = consume_chunk_row(
+                    res.tokens[i], bool(res.done[i]), int(res.lengths[i]),
+                    len(slot.emitted), self.chunk_len, self.eos_ids)
+            else:
+                new_ids, finish, wasted = scan_chunk_row(
+                    res.tokens[i], len(slot.emitted), self.eos_ids,
+                    slot.req.max_tokens)
+                self._wasted_steps += wasted
+            if new_ids:
+                piece = self._piece(new_ids, len(slot.emitted))
+                slot.emitted.extend(new_ids)
+                slot.req.out_queue.put_nowait(("token", piece))
+            if finish is not None:
+                self._finish(i, finish)
+
+    def _finish(self, slot_idx: int, finish: str,
+                error: Optional[BaseException] = None,
+                wasted_inflight: bool = False) -> None:
+        slot = self._slots[slot_idx]
+        self._slots[slot_idx] = None
+        if slot is None:  # pragma: no cover - defensive
+            return
+        # Mirror the batcher's billing: capped by the remaining token
+        # budget — the device freezes there, so a disconnect near natural
+        # completion can't read as a full pipe of waste.
+        if (wasted_inflight and self.device_termination
+                and slot.decode_chunks_inflight > 0):
+            remaining = max(0, slot.req.max_tokens - len(slot.emitted))
+            self._wasted_steps += min(
+                slot.decode_chunks_inflight * self.chunk_len, remaining)
+        if error is not None:
+            slot.req.out_queue.put_nowait(("error", error))
+            return
+        slot.req.out_queue.put_nowait(
+            ("done", self._result(slot.req, slot.emitted, finish)))
+
+    # ------------------------------------------------------------ serving
+
+    @staticmethod
+    def _piece(ids: List[int], offset: int) -> str:
+        """Token ids → text increment ("t<id>" words; offset decides
+        whether a leading separator is needed)."""
+        text = " ".join(f"t{t}" for t in ids)
+        return text if offset == 0 else " " + text
+
+    def _result(self, req: _FakeReq, ids: List[int],
+                finish: str) -> EngineResult:
+        return EngineResult(
+            text=" ".join(f"t{t}" for t in ids),
+            prompt_tokens=len(req.prompt.split()),
+            completion_tokens=len(ids),
+            finish_reason=finish,
+            engine=self.name,
+        )
+
+    async def _stream_events(self, prompt: str, *, max_tokens: int,
+                             timeout: Optional[float]):
+        if not self._ready:
+            raise EngineUnavailable("FakeChunkedEngine not started")
+        req = _FakeReq(
+            prompt=prompt,
+            max_tokens=max(1, max_tokens),
+            deadline=(time.monotonic() + timeout) if timeout else None,
+            out_queue=asyncio.Queue(),
+            cancel=asyncio.Event(),
+            stream=list(self.stream_fn(prompt)),
+        )
+        self._queue.append(req)
+        try:
+            while True:
+                if req.deadline is not None:
+                    remaining = req.deadline - time.monotonic()
+                    try:
+                        event, payload = await asyncio.wait_for(
+                            req.out_queue.get(), remaining + 2.0)
+                    except asyncio.TimeoutError:
+                        raise GenerationTimeout(
+                            "generation exceeded timeout")
+                else:
+                    event, payload = await req.out_queue.get()
+                if event == "error":
+                    raise payload
+                yield (event, payload)
+                if event == "done":
+                    return
+        finally:
+            req.cancel.set()
+
+    async def generate(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> EngineResult:
+        async for event, payload in self._stream_events(
+                prompt, max_tokens=max_tokens, timeout=timeout):
+            if event == "done":
+                return payload
+        raise EngineUnavailable("stream ended without a result")
+
+    async def generate_stream(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> AsyncIterator[str]:
+        async for event, payload in self._stream_events(
+                prompt, max_tokens=max_tokens, timeout=timeout):
+            if event == "token":
+                yield payload

@@ -331,6 +331,13 @@ class JaxEngine:
         except Exception:  # pragma: no cover - backend-dependent
             pass
 
+    def _fetch(self, arr) -> np.ndarray:
+        """THE device→host read. Every consumed pipeline entry performs
+        exactly one of these — the batcher's packed chunk buffers exist
+        so tokens, termination, and occupancy share it (tests assert the
+        one-fetch-per-chunk invariant by counting calls here)."""
+        return np.asarray(arr)
+
     def _new_cache(self, batch: int, max_seq: Optional[int] = None) -> KVCache:
         """Fresh KV cache, placed per the mesh policy when sharded serving
         is on (batch over ``data``, KV heads over ``model``)."""

@@ -7,7 +7,9 @@ engine-agnostic; everything below it is a particular inference backend.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import AsyncIterator, Optional, Protocol, runtime_checkable
+from typing import AsyncIterator, List, Optional, Protocol, Tuple, runtime_checkable
+
+import numpy as np
 
 
 class EngineUnavailable(RuntimeError):
@@ -30,6 +32,129 @@ class EngineOverloaded(EngineUnavailable):
 class GenerationTimeout(TimeoutError):
     """Generation exceeded the configured timeout → HTTP 504
     (reference app.py:189-191)."""
+
+
+# ---------------------------------------------------------------------------
+# Packed chunk-result contract (decode pipeline seam)
+#
+# A decode chunk returns ONE flat int32 buffer so tokens, termination, and
+# occupancy cross the host↔device link in a single fetch:
+#
+#     [ tokens (n_slots × chunk_len) | done_mask (n_slots)
+#       | live_lengths (n_slots) | n_alive (1) ]
+#
+# - ``tokens[i]``: the chunk's sampled token ids for slot i (entries past
+#   the slot's termination point repeat its last counted token — garbage
+#   by contract, never emitted).
+# - ``done_mask[i]``: slot i terminated (EOS or per-slot token budget) in
+#   or before this chunk, among the slots the dispatcher asked to run.
+# - ``live_lengths[i]``: slot i's CUMULATIVE completion-token count after
+#   this chunk (device-resident occupancy fact; the consumer derives this
+#   chunk's valid tokens as ``live_lengths[i] - already_emitted``).
+# - ``n_alive``: slots still decoding after the chunk — the scheduler's
+#   early-retirement signal.
+#
+# Both the jax batcher and the fake chunked engine build/consume exactly
+# this layout (schema version ``PACKED_CHUNK_VERSION``), so pipeline tests
+# on the fake engine exercise the real contract.
+# ---------------------------------------------------------------------------
+
+PACKED_CHUNK_VERSION = 1
+
+
+def packed_chunk_size(n_slots: int, chunk_len: int) -> int:
+    """Flat length of one packed chunk buffer."""
+    return n_slots * chunk_len + 2 * n_slots + 1
+
+
+@dataclass
+class ChunkResult:
+    """Host-side view of one unpacked decode chunk."""
+
+    tokens: np.ndarray      # [n_slots, chunk_len] int32
+    done: np.ndarray        # [n_slots] bool
+    lengths: np.ndarray     # [n_slots] int32 cumulative completion tokens
+    n_alive: int
+
+
+def pack_chunk(tokens, done, lengths, n_alive, *, xp=np):
+    """Flatten one chunk's results into the single-fetch buffer.
+
+    ``xp`` is the array namespace — ``numpy`` for the fake engine,
+    ``jax.numpy`` inside the jitted chunk program (the concatenate then
+    happens on device and the scheduler fetches one array)."""
+    return xp.concatenate([
+        xp.reshape(tokens, (-1,)).astype(xp.int32),
+        done.astype(xp.int32),
+        lengths.astype(xp.int32),
+        xp.reshape(xp.asarray(n_alive, dtype=xp.int32), (1,)),
+    ])
+
+
+def unpack_chunk(buf, n_slots: int, chunk_len: int) -> ChunkResult:
+    """Inverse of ``pack_chunk`` (always numpy — this is the host side)."""
+    buf = np.asarray(buf)
+    want = packed_chunk_size(n_slots, chunk_len)
+    if buf.shape != (want,):
+        raise ValueError(
+            f"packed chunk buffer has shape {buf.shape}, expected ({want},) "
+            f"for n_slots={n_slots} chunk_len={chunk_len}")
+    nt = n_slots * chunk_len
+    return ChunkResult(
+        tokens=buf[:nt].reshape(n_slots, chunk_len),
+        done=buf[nt:nt + n_slots].astype(bool),
+        lengths=buf[nt + n_slots:nt + 2 * n_slots].astype(np.int32),
+        n_alive=int(buf[-1]),
+    )
+
+
+def consume_chunk_row(tokens_row, done: bool, length: int,
+                      already_emitted: int, chunk_len: int,
+                      eos_ids) -> Tuple[List[int], Optional[str]]:
+    """Consume one slot's row of a packed chunk under DEVICE-side
+    termination. Returns ``(new_ids, finish)`` where ``finish`` is
+    ``"stop"`` / ``"length"`` / ``None``.
+
+    The device already decided termination; the host only recovers the
+    valid token span (``length - already_emitted``) and the finish
+    *reason*: a done slot whose next row entry is an EOS id stopped on
+    EOS (the EOS itself is never emitted, matching the host-scan
+    semantics); any other done slot exhausted its token budget. Shared by
+    the jax batcher and the fake chunked engine so the two can never
+    disagree on the contract."""
+    v = max(0, min(int(length) - already_emitted, chunk_len))
+    new_ids = [int(t) for t in tokens_row[:v]]
+    finish = None
+    if done:
+        if v < chunk_len and int(tokens_row[v]) in eos_ids:
+            finish = "stop"
+        else:
+            finish = "length"
+    return new_ids, finish
+
+
+def scan_chunk_row(tokens_row, already_emitted: int, eos_ids,
+                   max_tokens: int) -> Tuple[List[int], Optional[str], int]:
+    """Legacy HOST-side termination scan (``DEVICE_TERMINATION=false``):
+    walk the row until EOS or the token budget. Returns
+    ``(new_ids, finish, wasted_steps)`` — ``wasted_steps`` counts decode
+    steps the device executed past the slot's termination point (the
+    waste the device-resident done mask eliminates)."""
+    new_ids: List[int] = []
+    finish = None
+    steps = 0
+    for tid in tokens_row:
+        steps += 1
+        tid = int(tid)
+        if tid in eos_ids:
+            finish = "stop"
+            break
+        new_ids.append(tid)
+        if already_emitted + len(new_ids) >= max_tokens:
+            finish = "length"
+            break
+    wasted = len(tokens_row) - steps if finish is not None else 0
+    return new_ids, finish, wasted
 
 
 @dataclass

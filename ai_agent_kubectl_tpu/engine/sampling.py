@@ -116,6 +116,7 @@ def sample_tokens_batched(
     temperatures: jnp.ndarray,      # [batch] traced — per-slot temperature
     top_k: int = 0,
     top_p: float = 1.0,
+    active: jnp.ndarray | None = None,  # [batch] bool — rows still decoding
 ) -> jnp.ndarray:
     """Per-row sampling for the continuous-batching decode step: each slot
     carries its own temperature; top-k/top-p are static service config
@@ -124,16 +125,35 @@ def sample_tokens_batched(
     engines sample from the same distribution at the same settings. The
     categorical branch (gumbel noise + filtering — over batch×k when a
     top-k is set, batch×vocab otherwise) only executes when some slot
-    actually samples; all-greedy batches take the argmax-only path."""
+    actually samples; all-greedy batches take the argmax-only path.
+
+    ``active`` is the device-resident done mask's view of the batch
+    (engine/batcher.py): finished slots stop paying for sampling — a
+    batch whose only non-greedy rows have all terminated mid-chunk takes
+    the argmax-only branch, and dead rows never influence the taken
+    path. The caller still selects its own carry value for dead rows."""
     with jax.named_scope("sampling"):
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        wants_sample = temperatures > 0.0
+        if active is not None:
+            wants_sample = jnp.logical_and(wants_sample, active)
 
         def _with_sampling(_):
             t = jnp.maximum(temperatures, 1e-6)[:, None]
             sampled = _sample_filtered(logits / t, key, top_k, top_p)
-            return jnp.where(temperatures > 0.0, sampled, greedy)
+            return jnp.where(wants_sample, sampled, greedy)
 
         return jax.lax.cond(
-            jnp.any(temperatures > 0.0), _with_sampling, lambda _: greedy,
+            jnp.any(wants_sample), _with_sampling, lambda _: greedy,
             None,
         )
+
+
+def eos_mask(tokens: jnp.ndarray, eos_ids) -> jnp.ndarray:
+    """[batch] bool — which sampled tokens are termination ids. The EOS
+    set is tiny static service config (1-2 ids per model), so a broadcast
+    compare beats any vocab-sized membership structure; runs inside the
+    decode chunk's scan to fold termination into the carried active mask
+    (the device-resident done mask, engine/batcher.py)."""
+    eos_arr = jnp.asarray(tuple(eos_ids), jnp.int32)
+    return jnp.any(tokens[..., None] == eos_arr, axis=-1)

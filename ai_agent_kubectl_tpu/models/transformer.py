@@ -188,7 +188,8 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
            layer_k: jnp.ndarray, layer_v: jnp.ndarray,
            positions: jnp.ndarray, kv_limit: int,
            batch_idx: jnp.ndarray,
-           token_mask) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+           token_mask,
+           write_mask=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One transformer block. Returns (h_out, new_layer_k, new_layer_v).
 
     The ``jax.named_scope`` blocks here (and in ``forward``/sampling) are
@@ -196,6 +197,13 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
     scope path, which the profiler trace exports — the decode-step
     attribution tool (obs/attribution.py) bills device spans to op
     categories by these names instead of guessing from HLO op types.
+
+    ``write_mask`` ([B] bool, decode only): rows whose mask is False skip
+    the KV-cache scatter entirely — their write positions are pushed out
+    of bounds, and OOB scatter updates are dropped by jax. This is how
+    slots terminated mid-chunk by the device-resident done mask
+    (engine/batcher.py) stop mutating their cache region instead of
+    rewriting garbage at a frozen position every remaining step.
     """
     B, S, d = h.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -211,7 +219,14 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
         k = apply_rope(k, positions, cfg.rope_theta)
 
     # Write this chunk's K/V into the cache at its absolute positions.
-    # (scatter; positions are per-slot absolute indices)
+    # (scatter; positions are per-slot absolute indices). Dead rows
+    # (write_mask False) scatter at an out-of-bounds position, which jax
+    # drops — the cache row stays untouched.
+    if write_mask is not None:
+        _cap = (layer_k.q if isinstance(layer_k, QuantKV) else layer_k).shape[1]
+        w_pos = jnp.where(write_mask[:, None], positions, _cap)
+    else:
+        w_pos = positions
     if isinstance(layer_k, QuantKV):
         # int8 KV: quantize the fresh chunk at write; the read span stays
         # int8 all the way into the attention dots —
@@ -222,10 +237,10 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
         # k/v stay bf16 for the ring path.
         with jax.named_scope("kv_write"):
             qk, qv = kv_quantize(k), kv_quantize(v)
-            layer_k = QuantKV(q=layer_k.q.at[batch_idx, positions].set(qk.q),
-                              s=layer_k.s.at[batch_idx, positions].set(qk.s))
-            layer_v = QuantKV(q=layer_v.q.at[batch_idx, positions].set(qv.q),
-                              s=layer_v.s.at[batch_idx, positions].set(qv.s))
+            layer_k = QuantKV(q=layer_k.q.at[batch_idx, w_pos].set(qk.q),
+                              s=layer_k.s.at[batch_idx, w_pos].set(qk.s))
+            layer_v = QuantKV(q=layer_v.q.at[batch_idx, w_pos].set(qv.q),
+                              s=layer_v.s.at[batch_idx, w_pos].set(qv.s))
         if attn_impl == "paged" and S == 1:
             raise NotImplementedError(
                 "paged decode attention does not read int8 KV; the engine "
@@ -257,9 +272,9 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
         return h + mlp, layer_k, layer_v
     else:
         with jax.named_scope("kv_write"):
-            layer_k = layer_k.at[batch_idx, positions].set(
+            layer_k = layer_k.at[batch_idx, w_pos].set(
                 k.astype(layer_k.dtype))
-            layer_v = layer_v.at[batch_idx, positions].set(
+            layer_v = layer_v.at[batch_idx, w_pos].set(
                 v.astype(layer_v.dtype))
         k_ctx = layer_k[:, :kv_limit]
         v_ctx = layer_v[:, :kv_limit]
@@ -372,6 +387,11 @@ def forward(
                                       # (auto | ep | dense; see _moe_mlp)
     logits_at: Optional[jnp.ndarray] = None,   # [B] int32: emit logits only
                                       # at this position per row
+    write_mask: Optional[jnp.ndarray] = None,  # [B] bool: rows allowed to
+                                      # write KV (device-side termination —
+                                      # see _layer; ignored on the pipe
+                                      # path, whose dead slots keep the
+                                      # legacy frozen-position writes)
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run the model over a token chunk (prefill: S>1; decode: S=1).
 
@@ -425,7 +445,7 @@ def forward(
         def scan_body(h, xs):
             lp, layer_k, layer_v = xs
             h, new_k, new_v = step(h, lp, layer_k, layer_v, positions, kv_limit,
-                                   batch_idx, token_mask)
+                                   batch_idx, token_mask, write_mask)
             return h, (new_k, new_v)
 
         h, (new_k, new_v) = jax.lax.scan(

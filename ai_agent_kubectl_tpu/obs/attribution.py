@@ -390,7 +390,6 @@ def run_attribution(*, model: str = "gemma-7b-it", quant: str = "int8",
     import jax.numpy as jnp
 
     from ..engine.jax_engine import kv_bucket_ladder
-    from ..engine.sampling import sample_tokens_batched
     from ..models.config import get_config
     from ..models.transformer import KVCache, forward, init_params
 
@@ -408,24 +407,22 @@ def run_attribution(*, model: str = "gemma-7b-it", quant: str = "int8",
     if kv_limit is None:
         kv_limit = kv_bucket_ladder(S_alloc)[-1]   # the serving top bucket
 
-    def batched_chunk(params, tok, pos, cache, key, temps, active):
-        def body(carry, _):
-            tok, pos, cache, key = carry
-            logits, cache = forward(params, cfg, tok, pos, cache,
-                                    kv_limit=kv_limit, attn_impl="dense",
-                                    token_mask=active[:, None])
-            key, sub = jax.random.split(key)
-            nxt = sample_tokens_batched(logits[:, 0], sub, temps,
-                                        top_k=top_k, top_p=top_p)
-            nxt = jnp.where(active, nxt, tok[:, 0])
-            pos = pos + active.astype(jnp.int32)[:, None]
-            return (nxt[:, None], pos, cache, key), nxt
+    # THE serving chunk body, not a copy: make_termination_chunk_fn is the
+    # same builder BatchedJaxEngine compiles per KV bucket, so the traced
+    # program is engine-identical by construction (only the forward
+    # closure differs: single-device dense attention here).
+    from ..engine.batcher import make_termination_chunk_fn
 
-        (tok, pos, cache, key), toks = jax.lax.scan(
-            body, (tok, pos, cache, key), None, length=chunk_len)
-        return jnp.swapaxes(toks, 0, 1), tok, pos, cache, key
+    def forward_step(params, tok, pos, cache, live):
+        return forward(params, cfg, tok, pos, cache, kv_limit=kv_limit,
+                       attn_impl="dense", token_mask=live[:, None],
+                       write_mask=live)
 
-    fn = jax.jit(batched_chunk, donate_argnums=(1, 2, 3))
+    batched_chunk = make_termination_chunk_fn(
+        forward_step, chunk_len, tuple(sorted(set(cfg.eos_ids))),
+        top_k, top_p)
+
+    fn = jax.jit(batched_chunk, donate_argnums=(1, 2, 3, 7, 8))
 
     N = batch_size
     if S_alloc < (reps + 2) * chunk_len + 1:
@@ -440,7 +437,14 @@ def run_attribution(*, model: str = "gemma-7b-it", quant: str = "int8",
     cache = KVCache.zeros(cfg, N, S_alloc, dtype=jdtype, kv_quant=kv_quant)
     key = jax.random.PRNGKey(0)
     temps = jnp.zeros((N,), jnp.float32)
-    active = jnp.ones((N,), jnp.bool_)
+    # All lanes force-live with an unreachable budget, and fresh all-live
+    # carry state per dispatch: a sampled EOS from random-init weights
+    # must not progressively park lanes and time a partially-masked step.
+    force = jnp.ones((N,), jnp.bool_)
+    budget = jnp.full((N,), 1 << 30, jnp.int32)
+
+    def all_live():
+        return jnp.ones((N,), jnp.bool_), jnp.zeros((N,), jnp.int32)
 
     def sync(x):
         jax.block_until_ready(x)
@@ -449,18 +453,22 @@ def run_attribution(*, model: str = "gemma-7b-it", quant: str = "int8",
         leaf = jax.tree_util.tree_leaves(x)[0]
         np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
 
-    toks, tok, pos, cache, key = fn(params, tok, pos, cache, key,
-                                    temps, active)        # compile + warm
-    sync(toks)
+    active, ngen = all_live()
+    packed, tok, pos, cache, key, _, _ = fn(
+        params, tok, pos, cache, key, temps, force, active,
+        ngen, budget)                                     # compile + warm
+    sync(packed)
 
     trace_dir = tempfile.mkdtemp(prefix="attr_step_")
     t0 = time.perf_counter()
     try:
         with jax.profiler.trace(trace_dir):
             for _ in range(reps):
-                toks, tok, pos, cache, key = fn(params, tok, pos, cache,
-                                                key, temps, active)
-            sync(toks)
+                active, ngen = all_live()
+                packed, tok, pos, cache, key, _, _ = fn(
+                    params, tok, pos, cache, key, temps, force, active,
+                    ngen, budget)
+            sync(packed)
         wall_s = time.perf_counter() - t0
         steps = reps * chunk_len
         out = attribute_trace(trace_dir, steps, meta={

@@ -852,6 +852,48 @@ async def handle_debug_request_detail(request: web.Request) -> web.Response:
     return web.json_response(entry)
 
 
+async def handle_debug_chunks(request: web.Request) -> web.Response:
+    """GET /debug/chunks — the decode pipeline's flight record: the last
+    N chunk dispatch/consume/prune events (timestamps, KV bucket, device
+    n_alive, fetch latency) straight off the scheduler's ring buffer,
+    plus the live pipeline stats. The chunk-granular companion to
+    /debug/requests when 'serving is slower than the device' needs a
+    timeline, not a counter."""
+    denied = _debug_forbidden(request)
+    if denied is not None:
+        return denied
+    svc: Service = request.app["service"]
+    try:
+        limit = int(request.query.get("limit", 100))
+    except ValueError:
+        return _json_error(400, "limit must be an integer")
+    # The scheduler thread appends to the ring while we copy; CPython
+    # raises "deque mutated during iteration" rather than corrupting, so
+    # retry the snapshot a few times instead of 500ing the one endpoint
+    # meant for debugging a busy pipeline.
+    log = getattr(svc.engine, "_chunk_log", ())
+    events: list = []
+    for _ in range(5):
+        try:
+            events = list(log)
+            break
+        except RuntimeError:
+            continue
+    stats_fn = getattr(svc.engine, "stats", None)
+    stats = stats_fn() if callable(stats_fn) else {}
+    if stats:
+        # stats() drains the fetch-latency samples; forward them to the
+        # histogram rather than dropping them on the floor.
+        svc.metrics.observe_pipeline(stats)
+    keys = ("pipe_depth", "pipe_inflight", "device_active_slots",
+            "device_termination", "wasted_decode_steps",
+            "chunks_dispatched", "chunks_consumed", "chunks_pruned")
+    return web.json_response({
+        "events": events[-limit:] if limit > 0 else [],
+        "pipeline": {k: stats[k] for k in keys if k in stats},
+    })
+
+
 async def handle_metrics(request: web.Request) -> web.Response:
     svc: Service = request.app["service"]
     # Engine gauges are sampled at scrape time (live scheduler state, not a
@@ -864,6 +906,9 @@ async def handle_metrics(request: web.Request) -> web.Response:
         svc.metrics.queue_depth.set(stats.get("queue_depth", 0))
         svc.metrics.kv_pool_used.set(stats.get("kv_pages_used", 0))
         svc.metrics.kv_pool_total.set(stats.get("kv_pages_total", 0))
+        # Decode-pipeline metrics (pipe occupancy, wasted decode steps,
+        # chunk dispatch/consume/prune counts, fetch-latency histogram).
+        svc.metrics.observe_pipeline(stats)
     # Windowed throughput gauge: the batcher's own scheduler-side window
     # when it reports one (counts every finish, including streams), else
     # the service-side window fed by the response handlers.
@@ -891,6 +936,7 @@ def create_app(cfg: ServiceConfig, engine: Engine,
     app.router.add_post("/debug/trace", handle_debug_profile)  # pre-rename alias
     app.router.add_get("/debug/requests", handle_debug_requests)
     app.router.add_get("/debug/requests/{id}", handle_debug_request_detail)
+    app.router.add_get("/debug/chunks", handle_debug_chunks)
     app.router.add_get("/health", handle_health)
     app.router.add_get("/metrics", handle_metrics)
     # /openapi.json + /docs — unauthenticated like the reference's

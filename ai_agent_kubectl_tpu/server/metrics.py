@@ -157,6 +157,50 @@ class Metrics:
             "engine_prefix_cache_hits_total", "Prefix-KV cache hits", registry=r
         )
 
+        # Decode-pipeline metrics (ISSUE 4: device-side termination +
+        # deep chunk pipelining). Occupancy/config are gauges sampled at
+        # scrape; the waste/chunk counters are cumulative scheduler totals
+        # mirrored through ``observe_pipeline`` (delta-inc so restarts of
+        # the scrape path don't double-count); fetch latencies arrive as
+        # drained per-chunk samples.
+        self.pipe_occupancy = Gauge(
+            "decode_pipe_occupancy",
+            "Speculative decode chunks currently in flight",
+            registry=r,
+        )
+        self.pipe_depth = Gauge(
+            "decode_pipe_depth",
+            "Configured CHUNK_PIPE_DEPTH",
+            registry=r,
+        )
+        self.device_active_slots = Gauge(
+            "decode_device_active_slots",
+            "Live slots reported by the last consumed chunk's n_alive",
+            registry=r,
+        )
+        self.wasted_decode_steps = Counter(
+            "wasted_decode_steps_total",
+            "Decode steps executed for already-terminated slots "
+            "(~0 with DEVICE_TERMINATION=true)",
+            registry=r,
+        )
+        self.decode_chunks = Counter(
+            "decode_chunks_total",
+            "Decode chunk pipeline events",
+            ["event"],  # dispatch | consume | prune
+            registry=r,
+        )
+        self.chunk_fetch = Histogram(
+            "chunk_fetch_seconds",
+            "Blocking device->host fetch latency per consumed chunk",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5),
+            registry=r,
+        )
+        # Last-seen cumulative totals for the delta-inc mirror.
+        self._pipe_seen = {"wasted": 0, "dispatch": 0, "consume": 0,
+                           "prune": 0}
+
         # Failure-containment metrics (overload shedding / breaker /
         # degraded fallback)
         self.queue_rejections = Counter(
@@ -187,6 +231,30 @@ class Metrics:
             buckets=_PHASE_BUCKETS,
             registry=r,
         )
+
+    def observe_pipeline(self, stats: dict) -> None:
+        """Mirror the batcher's decode-pipeline stats into Prometheus at
+        scrape time: gauges set directly, cumulative scheduler totals
+        turned into counter increments (the engine owns the running
+        total; a scrape only publishes the delta since the last one), and
+        drained chunk-fetch samples observed into the histogram."""
+        self.pipe_occupancy.set(stats.get("pipe_inflight", 0))
+        self.pipe_depth.set(stats.get("pipe_depth", 0))
+        self.device_active_slots.set(stats.get("device_active_slots", 0))
+        wasted = stats.get("wasted_decode_steps", 0)
+        if wasted > self._pipe_seen["wasted"]:
+            self.wasted_decode_steps.inc(wasted - self._pipe_seen["wasted"])
+            self._pipe_seen["wasted"] = wasted
+        for event, key in (("dispatch", "chunks_dispatched"),
+                           ("consume", "chunks_consumed"),
+                           ("prune", "chunks_pruned")):
+            total = stats.get(key, 0)
+            if total > self._pipe_seen[event]:
+                self.decode_chunks.labels(event=event).inc(
+                    total - self._pipe_seen[event])
+                self._pipe_seen[event] = total
+        for s in stats.get("chunk_fetch_secs", ()):
+            self.chunk_fetch.observe(s)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -231,6 +231,29 @@ def build_openapi() -> Dict:
                 "404": _err("Request ID not (or no longer) in the ring"),
             },
         }},
+        "/debug/chunks": {"get": {
+            "summary": "Decode-pipeline flight record: recent chunk "
+                       "dispatch/consume/prune events + live stats",
+            "description": "The batch scheduler's chunk-event ring "
+                           "(timestamps, KV bucket, device n_alive, "
+                           "fetch latency) plus pipeline stats — pipe "
+                           "depth/occupancy, device-side termination "
+                           "state, wasted decode steps, chunk totals. "
+                           "Same auth/token gating as /debug/profile.",
+            "parameters": [{
+                "name": "limit", "in": "query", "required": False,
+                "schema": {"type": "integer", "default": 100},
+                "description": "Newest events to return (<=0 for none)",
+            }],
+            "responses": {
+                "200": {"description": "{events: [...], pipeline: "
+                                       "{pipe_depth, pipe_inflight, "
+                                       "device_active_slots, "
+                                       "wasted_decode_steps, ...}}"},
+                "401": auth_err,
+                "403": _err("Invalid or missing X-Debug-Token"),
+            },
+        }},
     }
 
     return {

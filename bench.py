@@ -304,6 +304,62 @@ async def phase_7b(batch_size: int, max_seq: int, kv_quant: str,
     }
 
 
+async def phase_pipe7b(batch_size: int, max_seq: int, kv_quant: str,
+                       pipe_depth: int, chunk_len: int = 16) -> dict:
+    """One rung of the CHUNK_PIPE_DEPTH sweep (ISSUE 4): serving
+    throughput at the 7B geometry with the given pipeline depth. Its own
+    subprocess per rung (like every phase — torn-down engines don't
+    return HBM promptly), throughput only (no TTFT distribution: the
+    sweep's question is whether the serving number tracks the ~1,441
+    tok/s device ceiling as the pipe deepens, and what depth 1 — the
+    no-overlap baseline — loses to the tunnel RTT)."""
+    import jax
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "not on TPU"}
+
+    cfg7 = get_config("gemma-7b-it")
+    tok7, _ = make_tokenizer(cfg7)
+    log(f"bench: pipe7b rung bs={batch_size} depth={pipe_depth} "
+        f"max_seq={max_seq} kv_quant={kv_quant or 'bf16'}")
+    eng = BatchedJaxEngine(
+        cfg7,
+        tokenizer=tok7,
+        dtype="bfloat16",
+        quant="int8",
+        kv_quant=kv_quant,
+        max_seq_len=max_seq,
+        prefill_buckets=(64, 128),
+        batch_size=batch_size,
+        chunk_len=chunk_len,
+        chunk_pipe_depth=pipe_depth,
+    )
+    t0 = time.monotonic()
+    await eng.start()
+    log(f"bench: pipe7b engine ready in {time.monotonic() - t0:.1f}s")
+    samples = await throughput_phase(
+        eng, conc=batch_size, max_tokens=64, rounds=2,
+        tag=f"pipe7b-d{pipe_depth}")
+    stats = eng.stats()
+    await eng.stop()
+    return {
+        "model": "gemma-7b-it",
+        "batch_size": batch_size,
+        "max_seq_len": max_seq,
+        "kv_quant": kv_quant,
+        "pipe_depth": pipe_depth,
+        "device_termination": stats.get("device_termination", True),
+        "wasted_decode_steps": stats.get("wasted_decode_steps", 0),
+        "chunks_dispatched": stats.get("chunks_dispatched", 0),
+        "chunks_pruned": stats.get("chunks_pruned", 0),
+        "tokens_per_sec_per_chip": round(
+            statistics.median(samples) / len(jax.devices()), 2),
+    }
+
+
 def phase_attr7b(batch_size: int, max_seq: int, kv_quant: str) -> dict:
     """Decode-step cost attribution for the 7B geometry that just served
     (VERDICT r5 weak #1): the engine-identical donated chunk under
@@ -506,6 +562,37 @@ def orchestrate() -> dict:
         if rattr is not None and "skipped" not in rattr:
             extra7["step_attribution"] = rattr
 
+        # CHUNK_PIPE_DEPTH sweep at the bs=64/48 rungs (ISSUE 4): one
+        # subprocess per (bs, depth) — how far the serving number moves
+        # toward the ~1,441 tok/s device ceiling as the pipe deepens on
+        # top of device-side termination. The rung that just served
+        # sweeps first; 48 (the proven fallback geometry) rides along
+        # when a different rung won. A failed rung is logged and skipped
+        # — the sweep is an artifact, never a gate on the 7B numbers.
+        sweep = {}
+        rungs = [extra7["batch_size"]]
+        if 48 not in rungs:
+            rungs.append(48)
+        for bs in rungs:
+            for depth in (1, 2, 3, 4):
+                rp = _run_phase(
+                    ["--phase", "pipe7b", "--bs", str(bs),
+                     "--max-seq", str(extra7["max_seq_len"]),
+                     "--kv-quant", extra7["kv_quant"],
+                     "--pipe-depth", str(depth)],
+                    timeout=1800)
+                if rp is None or "skipped" in rp:
+                    log(f"bench: pipe7b bs={bs} depth={depth} "
+                        f"unavailable; continuing sweep")
+                    continue
+                sweep[f"bs{bs}_depth{depth}"] = {
+                    k: rp[k] for k in ("tokens_per_sec_per_chip",
+                                       "wasted_decode_steps",
+                                       "chunks_pruned")
+                }
+        if sweep:
+            extra7["pipe_depth_sweep"] = sweep
+
     rmoe = _run_phase(["--phase", "moe"], timeout=2400)
 
     r2 = _run_phase(["--phase", "2b"], timeout=2400)
@@ -535,17 +622,23 @@ def orchestrate() -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--phase", choices=["7b", "2b", "moe", "attr7b"],
+    ap.add_argument("--phase", choices=["7b", "2b", "moe", "attr7b",
+                                        "pipe7b"],
                     default=None)
     ap.add_argument("--bs", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=256)
     ap.add_argument("--kv-quant", default="")
     ap.add_argument("--chunk-len", type=int, default=16)
+    ap.add_argument("--pipe-depth", type=int, default=3)
     ns = ap.parse_args()
 
     if ns.phase == "7b":
         result = asyncio.run(
             phase_7b(ns.bs, ns.max_seq, ns.kv_quant, ns.chunk_len))
+    elif ns.phase == "pipe7b":
+        result = asyncio.run(
+            phase_pipe7b(ns.bs, ns.max_seq, ns.kv_quant, ns.pipe_depth,
+                         ns.chunk_len))
     elif ns.phase == "attr7b":
         result = phase_attr7b(ns.bs, ns.max_seq, ns.kv_quant)
     elif ns.phase == "2b":

@@ -211,11 +211,19 @@ def test_from_config_round_trips_scheduler_shape(monkeypatch):
     eng = BatchedJaxEngine.from_config(cfg)
     assert eng.chunk_len == 16
     assert eng.chunk_pipe_depth == 3
-    # Defaults are the bench-proven values (BENCH_r04: chunk 16 / depth 2).
+    # Defaults: chunk 16 (bench-proven, BENCH_r04) / depth 3 (device-side
+    # termination made the deeper pipe free on tails — ISSUE 4), with
+    # DEVICE_TERMINATION defaulting on.
     monkeypatch.delenv("CHUNK_LEN")
     monkeypatch.delenv("CHUNK_PIPE_DEPTH")
     dflt = ServiceConfig.from_env(env_file=None)
-    assert (dflt.chunk_len, dflt.chunk_pipe_depth) == (16, 2)
+    assert (dflt.chunk_len, dflt.chunk_pipe_depth) == (16, 3)
+    assert dflt.device_termination is True
+    monkeypatch.setenv("DEVICE_TERMINATION", "false")
+    off = ServiceConfig.from_env(env_file=None)
+    assert off.device_termination is False
+    eng_off = BatchedJaxEngine.from_config(off)
+    assert eng_off.device_termination is False
 
 
 def test_resolve_decode_attn_heuristic():

@@ -85,11 +85,59 @@ def print_phase_summary(samples: Dict[str, List[float]]) -> None:
             f"{max(vals):>9.1f}")
 
 
+def parse_prom_gauges(text: str) -> Dict[str, float]:
+    """Minimal Prometheus exposition parse: unlabelled samples only (the
+    pipeline gauges/counters the probe prints are all unlabelled)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        if not name:
+            continue
+        try:
+            out[name.strip()] = float(value)
+        except ValueError:
+            pass
+    return out
+
+
+async def print_pipeline_summary(session, base_url: str, headers) -> None:
+    """Wasted-chunk rate + pipe-depth occupancy from /metrics (ISSUE 4):
+    how much of the decode pipeline's speculative work was thrown away,
+    and how full the inflight window actually runs."""
+    try:
+        async with session.get(base_url + "/metrics",
+                               headers=headers) as resp:
+            gauges = parse_prom_gauges(await resp.text())
+    except Exception as e:  # pragma: no cover - network-dependent
+        log(f"probe[pipeline]: /metrics unreachable ({e})")
+        return
+    consumed = gauges.get('decode_chunks_total{event="consume"}', 0.0)
+    wasted = gauges.get("wasted_decode_steps_total", 0.0)
+    depth = gauges.get("decode_pipe_depth", 0.0)
+    occ = gauges.get("decode_pipe_occupancy", 0.0)
+    if not depth and "engine_batch_occupancy" not in gauges:
+        log("probe[pipeline]: no decode-pipeline metrics exposed "
+            "(engine without the chunked scheduler?)")
+        return
+    log("probe[pipeline]: decode pipeline")
+    log(f"  pipe depth (configured)     {depth:>8.0f}")
+    log(f"  pipe occupancy (now)        {occ:>8.0f}")
+    log(f"  device live slots (n_alive) "
+        f"{gauges.get('decode_device_active_slots', 0.0):>8.0f}")
+    log(f"  wasted decode steps total   {wasted:>8.0f}")
+    if consumed:
+        log(f"  wasted steps / consumed chunk {wasted / consumed:>8.2f}")
+
+
 async def http_probe(args) -> None:
     """Drive a live server: per-request Server-Timing phases + summary."""
     import aiohttp
 
-    url = args.url.rstrip("/") + "/kubectl-command"
+    base = args.url.rstrip("/")
+    url = base + "/kubectl-command"
     headers = {}
     if args.api_key:
         headers["X-API-Key"] = args.api_key
@@ -118,7 +166,8 @@ async def http_probe(args) -> None:
     async with aiohttp.ClientSession() as session:
         await asyncio.gather(*[one(session, i)
                                for i in range(args.requests)])
-    print_phase_summary(samples)
+        print_phase_summary(samples)
+        await print_pipeline_summary(session, base, headers)
 
 
 async def main() -> None:
@@ -211,19 +260,35 @@ async def main() -> None:
     await eng.stop()
     cache, tokd, posd, temps = eng._cache, eng._tok_d, eng._pos_d, eng._temps_d
     key = jax.random.PRNGKey(0)
-    active = jnp.ones((args.bs,), jnp.bool_)
+    # Every slot force-live with an unreachable budget: the ceiling wants
+    # all lanes decoding for the whole chained run, never terminating.
+    # active/ngen are donated carries — feed fresh all-live state every
+    # dispatch so a stray sampled EOS can't progressively park lanes and
+    # flatter the ceiling (it can still freeze a lane mid-chunk, which is
+    # the same variance a real all-live batch has).
+    force = jnp.ones((args.bs,), jnp.bool_)
+    budget = jnp.full((args.bs,), 1 << 30, jnp.int32)
+
+    def all_live():
+        return jnp.ones((args.bs,), jnp.bool_), jnp.zeros((args.bs,),
+                                                          jnp.int32)
+
     from _bench_sync import force_sync as _sync
 
     for kv_b in eng._kv_buckets:
         fn = eng._batch_chunk_fns[kv_b]
-        toks, tokd, posd, cache, key = fn(eng.params, tokd, posd, cache, key,
-                                          temps, active)
-        _sync(toks)
+        active, ngen = all_live()
+        packed, tokd, posd, cache, key, _, _ = fn(
+            eng.params, tokd, posd, cache, key, temps, force, active, ngen,
+            budget)
+        _sync(packed)
         t0 = time.monotonic()
         for _ in range(args.reps):
-            toks, tokd, posd, cache, key = fn(eng.params, tokd, posd, cache,
-                                              key, temps, active)
-        _sync(toks)
+            active, ngen = all_live()
+            packed, tokd, posd, cache, key, _, _ = fn(
+                eng.params, tokd, posd, cache, key, temps, force, active,
+                ngen, budget)
+        _sync(packed)
         dt = (time.monotonic() - t0) / args.reps
         per_step = dt / eng.chunk_len * 1000
         log(f"probe[ceiling]: kv_bucket={kv_b}: chunk={dt*1000:.1f}ms"
