@@ -234,8 +234,27 @@ class ServiceConfig:
     breaker_threshold: int = 5              # BREAKER_THRESHOLD
     breaker_window_secs: float = 30.0       # BREAKER_WINDOW_SECS
     breaker_recovery_secs: float = 15.0     # BREAKER_RECOVERY_SECS
+    # --- blast-radius containment (the INNER ring; engine/containment.py)
+    # Device-side per-slot health detection in the decode chunk: NaN/Inf
+    # logits and out-of-range sampled token ids trip a health word in the
+    # packed chunk buffer, freezing the slot mid-chunk and feeding the
+    # quarantine pass. false drops detection (step-exception containment
+    # stays).
+    slot_health_check: bool = True          # SLOT_HEALTH_CHECK
+    # How many times one request may be solo-implicated in a poisoned
+    # step (health bit, or isolated by bisection) and still be replayed;
+    # past this it fails terminally with HTTP 410. 0 = quarantine on
+    # first trip.
+    quarantine_retry_budget: int = 1        # QUARANTINE_RETRY_BUDGET
+    # Engine reset-and-replay rate limit (per rolling minute): past it
+    # the engine stops resetting and fails the affected requests fast —
+    # the errors feed the circuit breaker, which is the outer ring's
+    # job. 0 = unlimited.
+    engine_reset_max_per_min: int = 12      # ENGINE_RESET_MAX_PER_MIN
     # Fault-injection harness (testing/faults.py):
-    # "admit:error:0.5,chunk:hang,generate:delay:2.0". Empty disables.
+    # "admit:error:0.5,chunk:hang,generate:delay:2.0" — plus the
+    # containment drills "decode:nan:<p>", "decode:poison_step",
+    # "scheduler:die". Empty disables.
     fault_points: str = ""                  # FAULT_POINTS
 
     # --- observability ---
@@ -333,6 +352,9 @@ class ServiceConfig:
             breaker_threshold=_env_int("BREAKER_THRESHOLD", 5),
             breaker_window_secs=_env_float("BREAKER_WINDOW_SECS", 30.0),
             breaker_recovery_secs=_env_float("BREAKER_RECOVERY_SECS", 15.0),
+            slot_health_check=_env_bool("SLOT_HEALTH_CHECK", True),
+            quarantine_retry_budget=_env_int("QUARANTINE_RETRY_BUDGET", 1),
+            engine_reset_max_per_min=_env_int("ENGINE_RESET_MAX_PER_MIN", 12),
             fault_points=_env_str("FAULT_POINTS", "") or "",
             flight_recorder_size=_env_int("FLIGHT_RECORDER_SIZE", 256),
             debug_token=_env_str("DEBUG_TOKEN", None),

@@ -38,6 +38,7 @@ import logging
 import queue as _queue
 import threading
 import time
+import zlib
 from functools import partial
 from typing import List, Optional
 
@@ -49,11 +50,15 @@ from ..models.transformer import KVCache, forward
 from ..obs.trace import Trace, current_trace
 from ..ops.quant import (kv_broadcast_rows, kv_set_slots, kv_slot_update,
                          kv_tokens, kv_update_slice)
+from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
+                          CAUSE_SLOT_HEALTH, PROBATION_CLEAN_CHUNKS,
+                          REASON_HEALTH, REASON_ISOLATED, EngineSupervisor)
 from .jax_engine import JaxEngine
-from .protocol import (EngineOverloaded, EngineResult, EngineUnavailable,
-                       GenerationTimeout, consume_chunk_row, pack_chunk,
-                       scan_chunk_row, unpack_chunk)
-from .sampling import eos_mask, sample_tokens_batched
+from .protocol import (HEALTH_NONFINITE, HEALTH_TOKEN_RANGE, EngineOverloaded,
+                       EngineResult, EngineUnavailable, GenerationTimeout,
+                       RequestQuarantined, consume_chunk_row, describe_health,
+                       pack_chunk, scan_chunk_row, unpack_chunk)
+from .sampling import eos_mask, sample_tokens_seeded
 from .tokenizer import StreamDecoder
 
 logger = logging.getLogger(__name__)
@@ -98,12 +103,26 @@ def resolve_decode_attn(decode_attn: str, cfg, *, kv_quant: str, pipe: int,
 
 def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
                               top_k: int, top_p: float,
+                              vocab_size: int = 0,
+                              health_check: bool = True,
                               finalize=lambda arr: arr):
     """Build THE device-termination decode-chunk body: a ``lax.scan`` of
     ``chunk_len`` steps whose carry folds EOS + per-slot token budgets
     into the live mask (finished slots stop sampling, KV writes, and
     position advances mid-chunk) and whose result is the single packed
-    ``[tokens, done_mask, live_lengths, n_alive]`` buffer (protocol.py).
+    ``[tokens, done_mask, live_lengths, health, n_alive]`` buffer
+    (protocol.py v2).
+
+    Fault containment (ISSUE 5) lives in the same scan: per-slot health
+    detection (``health_check``) folds NaN/Inf logits and out-of-range
+    sampled ids into a carried health word and FREEZES a tripped slot
+    mid-chunk — corruption stops propagating into that slot's KV before
+    the host has even seen the chunk — and sampling runs per-request RNG
+    streams (``sample_tokens_seeded`` over the spliced ``seeds`` vector)
+    so a reset-and-replay reproduces transcripts bit-identically. The
+    ``corrupt`` vector is the fault-injection seam (``decode:nan``):
+    all-False in normal serving, it NaNs a slot's step logits so drills
+    exercise the real detection path, not a shortcut.
 
     Shared by the serving engine and obs/attribution.py so "the traced
     program IS the serving program" holds by construction, not by
@@ -113,20 +132,40 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
     post-processes the packed buffer (the engine pins it replicated
     under a mesh)."""
 
-    def batched_chunk(params, tok, pos, cache, key, temps, force,
-                      active, ngen, budget):
+    def batched_chunk(params, tok, pos, cache, seeds, temps, force,
+                      active, ngen, budget, corrupt):
         live0 = jnp.logical_and(active, force)
+        health0 = jnp.zeros_like(ngen)
 
         def body(carry, _):
-            tok, pos, cache, key, live, ngen = carry
+            tok, pos, cache, live, ngen, health = carry
             logits, cache = forward_step(params, tok, pos, cache, live)
-            key, sub = jax.random.split(key)
-            nxt = sample_tokens_batched(logits[:, 0], sub, temps,
-                                        top_k=top_k, top_p=top_p,
-                                        active=live)
+            step_logits = logits[:, 0]
+            step_logits = jnp.where(corrupt[:, None],
+                                    jnp.float32(jnp.nan), step_logits)
+            nxt = sample_tokens_seeded(step_logits, seeds, ngen, temps,
+                                       top_k=top_k, top_p=top_p,
+                                       active=live)
             # Termination fold — a handful of [N]-vector compares the
             # attribution tool bills with the sampling chain.
             with jax.named_scope("sampling"):
+                if health_check:
+                    # Per-slot corruption detection: a tripped slot is
+                    # frozen HERE (its garbage token is never counted,
+                    # its KV writes stop next step) and its health bit
+                    # rides the packed buffer to the quarantine pass.
+                    bad_logit = jnp.logical_not(
+                        jnp.all(jnp.isfinite(step_logits), axis=-1))
+                    health = health | jnp.where(
+                        jnp.logical_and(live, bad_logit),
+                        HEALTH_NONFINITE, 0)
+                    if vocab_size > 0:
+                        bad_tok = jnp.logical_or(nxt < 0,
+                                                 nxt >= vocab_size)
+                        health = health | jnp.where(
+                            jnp.logical_and(live, bad_tok),
+                            HEALTH_TOKEN_RANGE, 0)
+                    live = jnp.logical_and(live, health == 0)
                 nxt = jnp.where(live, nxt, tok[:, 0])
                 hit_eos = jnp.logical_and(eos_mask(nxt, eos_ids), live)
                 counted = jnp.logical_and(live, jnp.logical_not(hit_eos))
@@ -135,16 +174,16 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
                     hit_eos, jnp.logical_and(counted, ngen >= budget))
                 live = jnp.logical_and(live, jnp.logical_not(done_now))
                 pos = pos + counted.astype(jnp.int32)[:, None]
-            return (nxt[:, None], pos, cache, key, live, ngen), nxt
+            return (nxt[:, None], pos, cache, live, ngen, health), nxt
 
-        (tok, pos, cache, key, live, ngen), toks = jax.lax.scan(
-            body, (tok, pos, cache, key, live0, ngen), None,
+        (tok, pos, cache, live, ngen, health), toks = jax.lax.scan(
+            body, (tok, pos, cache, live0, ngen, health0), None,
             length=chunk_len)
         toks = jnp.swapaxes(toks, 0, 1)
         done = jnp.logical_and(force, jnp.logical_not(live))
         packed = finalize(pack_chunk(toks, done, ngen, jnp.sum(live),
-                                     xp=jnp))
-        return packed, tok, pos, cache, key, live, ngen
+                                     health=health, xp=jnp))
+        return packed, tok, pos, cache, live, ngen
 
     return batched_chunk
 
@@ -165,6 +204,27 @@ class _Request:
     # the flight-recorder timeline shows admissions/first-token/finish
     # as the scheduler saw them.
     trace: Optional[Trace] = None
+    # Per-request sampling seed (ISSUE 5): every sampled token is drawn
+    # from fold_in(PRNGKey(seed), generation_index) — engine/sampling.py
+    # slot_keys — so the token stream is a pure function of (seed,
+    # logits), independent of batch composition or engine resets. Minted
+    # deterministically from the prompt when the caller doesn't supply
+    # one; exposed on the trace so /debug/requests/{id} makes any
+    # transcript reproducible offline.
+    seed: int = 0
+    # Raw prompt text, kept for decode-fault targeting
+    # (testing/faults.py target_substr) and trace readability.
+    prompt: str = ""
+    # Quarantine bookkeeping (engine/containment.py): how many times this
+    # request has been solo-implicated in a poisoned step. Survives
+    # resets/parking; past QUARANTINE_RETRY_BUDGET → RequestQuarantined.
+    suspect_count: int = 0
+    # Standing bisection suspicion: True while this request is in the
+    # pool a step-wide fault is being narrowed over. Lets early
+    # exoneration (PROBATION_CLEAN_CHUNKS) re-mix exonerated cohabitants
+    # and new admissions into the batch without widening the next
+    # bisection back out to everyone.
+    suspect: bool = False
 
 
 @dataclasses.dataclass
@@ -208,6 +268,9 @@ class BatchedJaxEngine(JaxEngine):
                  chunk_pipe_depth: int = 3,
                  max_queue_depth: int = 64,
                  device_termination: bool = True,
+                 slot_health_check: bool = True,
+                 quarantine_retry_budget: int = 1,
+                 reset_max_per_min: int = 12,
                  faults=None,
                  **kwargs):
         super().__init__(*args, **kwargs)
@@ -271,9 +334,25 @@ class BatchedJaxEngine(JaxEngine):
         # queue depth raise EngineOverloaded at submit time instead of
         # waiting llm_timeout for a slot that cannot come. 0 = unbounded.
         self.max_queue_depth = max(0, max_queue_depth)
-        #: testing/faults.py injector (admit / chunk points); None in
-        #: normal serving.
+        #: testing/faults.py injector (admit / chunk / decode / scheduler
+        #: points); None in normal serving.
         self.faults = faults
+        # Fault containment (ISSUE 5, the INNER ring): device-side slot
+        # health detection + quarantine + reset-and-replay. The
+        # supervisor owns policy/counters; this scheduler owns the
+        # mechanism (_contain_poisoned_step / _reset_decode_state /
+        # _replay_slot). SLOT_HEALTH_CHECK=false drops the in-chunk
+        # detection (the step-exception containment stays).
+        self.slot_health_check = slot_health_check
+        self.supervisor = EngineSupervisor(
+            retry_budget=quarantine_retry_budget,
+            max_resets_per_min=reset_max_per_min)
+        # Bisection probation (step-wide poison, culprit unknown): slots
+        # parked out of the batch while the probe half replays. Each
+        # entry is a _Slot with its detok (generated-so-far prefix) and
+        # timings intact; unparked slots resume via _replay_slot.
+        self._parked: List[_Slot] = []
+        self._probation_clean = 0  # clean chunks consumed this probation
         self._rejections = 0       # EngineOverloaded sheds (stats())
         # Completion timestamps feeding the live drain-rate estimate that
         # prices Retry-After on sheds. Appended from the scheduler thread,
@@ -313,6 +392,14 @@ class BatchedJaxEngine(JaxEngine):
                                    # drain must count them as busy (an
                                    # admission's prefill can run for
                                    # seconds on the scheduler thread)
+        self._admitting_reqs: List[_Request] = []
+                                   # the popped requests themselves: in
+                                   # neither _slots nor the queue, so if
+                                   # the scheduler thread dies mid-
+                                   # admission (BaseException) only this
+                                   # list lets the supervisor requeue
+                                   # them instead of leaking a generate()
+                                   # blocked forever
 
     @classmethod
     def from_config(cls, cfg, faults=None) -> "BatchedJaxEngine":
@@ -359,6 +446,9 @@ class BatchedJaxEngine(JaxEngine):
             admit_scratch_mb=cfg.admit_scratch_mb,
             max_queue_depth=cfg.max_queue_depth,
             device_termination=cfg.device_termination,
+            slot_health_check=cfg.slot_health_check,
+            quarantine_retry_budget=cfg.quarantine_retry_budget,
+            reset_max_per_min=cfg.engine_reset_max_per_min,
             faults=faults,
         )
 
@@ -476,25 +566,33 @@ class BatchedJaxEngine(JaxEngine):
             # with obs/attribution.py: ``force`` is the host's view of
             # live slots (excludes freed/exhausted), ``active``/``ngen``
             # the device-resident carry, ``budget`` the per-slot
-            # max_tokens vector set at splice time; ONE packed buffer
-            # (pinned replicated under a mesh) returns tokens +
-            # termination + occupancy in a single fetch per chunk.
+            # max_tokens vector set at splice time, ``seeds`` the
+            # per-request sampling seeds, ``corrupt`` the decode:nan
+            # fault seam; ONE packed buffer (pinned replicated under a
+            # mesh) returns tokens + termination + occupancy + per-slot
+            # health in a single fetch per chunk.
             return make_termination_chunk_fn(
                 chunk_forward_step(kv_limit), self.chunk_len, eos_ids,
-                self.top_k, self.top_p, finalize=self._replicated)
+                self.top_k, self.top_p, vocab_size=cfg.vocab_size,
+                health_check=self.slot_health_check,
+                finalize=self._replicated)
 
-        def batched_chunk_legacy(params, tok, pos, cache, key, temps, force,
-                                 active, ngen, budget, *, kv_limit):
+        def batched_chunk_legacy(params, tok, pos, cache, seeds, temps,
+                                 force, active, ngen, budget, corrupt, *,
+                                 kv_limit):
             """DEVICE_TERMINATION=false: the pre-ISSUE-4 chunk body —
             every force-live slot decodes the full chunk (finished slots
             keep producing garbage the host discards after its EOS scan).
             Same signature and packed-buffer contract as ``batched_chunk``
             so the dispatch/consume plumbing is identical; the done mask
             is all-False (the host scan decides) and live_lengths advance
-            by the full chunk."""
+            by the full chunk. Health detection still runs (sticky over
+            the chunk) — the legacy path is an A/B for termination, not
+            an opt-out of corruption containment — but nothing freezes:
+            the host-side quarantine pass discards the chunk."""
 
             def body(carry, _):
-                tok, pos, cache, key = carry
+                tok, pos, cache, ngen, health = carry
                 logits, cache = forward(params, cfg, tok, pos, cache,
                                         kv_limit=kv_limit,
                                         attn_impl=self._decode_impl,
@@ -502,23 +600,39 @@ class BatchedJaxEngine(JaxEngine):
                                         moe_impl=self.moe_impl,
                                         token_mask=force[:, None],
                                         page_size=self.kv_page_size)
-                key, sub = jax.random.split(key)
-                nxt = sample_tokens_batched(logits[:, 0], sub, temps,
-                                            top_k=self.top_k,
-                                            top_p=self.top_p)
-                nxt = jnp.where(force, nxt, tok[:, 0])
-                pos = pos + force.astype(jnp.int32)[:, None]
-                return (nxt[:, None], pos, cache, key), nxt
+                step_logits = logits[:, 0]
+                step_logits = jnp.where(corrupt[:, None],
+                                        jnp.float32(jnp.nan), step_logits)
+                nxt = sample_tokens_seeded(step_logits, seeds, ngen, temps,
+                                           top_k=self.top_k,
+                                           top_p=self.top_p)
+                with jax.named_scope("sampling"):
+                    if self.slot_health_check:
+                        bad = jnp.logical_not(
+                            jnp.all(jnp.isfinite(step_logits), axis=-1))
+                        health = health | jnp.where(
+                            jnp.logical_and(force, bad),
+                            HEALTH_NONFINITE, 0)
+                        bad_tok = jnp.logical_or(
+                            nxt < 0, nxt >= cfg.vocab_size)
+                        health = health | jnp.where(
+                            jnp.logical_and(force, bad_tok),
+                            HEALTH_TOKEN_RANGE, 0)
+                    nxt = jnp.where(force, nxt, tok[:, 0])
+                    pos = pos + force.astype(jnp.int32)[:, None]
+                    ngen = ngen + force.astype(jnp.int32)
+                return (nxt[:, None], pos, cache, ngen, health), nxt
 
-            (tok, pos, cache, key), toks = jax.lax.scan(
-                body, (tok, pos, cache, key), None, length=self.chunk_len
+            health0 = jnp.zeros_like(ngen)
+            (tok, pos, cache, ngen, health), toks = jax.lax.scan(
+                body, (tok, pos, cache, ngen, health0), None,
+                length=self.chunk_len
             )
             toks = jnp.swapaxes(toks, 0, 1)
-            ngen = ngen + force.astype(jnp.int32) * self.chunk_len
             packed = self._replicated(
                 pack_chunk(toks, jnp.zeros_like(force), ngen,
-                           jnp.sum(force), xp=jnp))
-            return packed, tok, pos, cache, key, active, ngen
+                           jnp.sum(force), health=health, xp=jnp))
+            return packed, tok, pos, cache, active, ngen
 
         def chunk_body(kv_limit):
             if self.device_termination:
@@ -533,16 +647,19 @@ class BatchedJaxEngine(JaxEngine):
         }
 
         def splice(cache, src_k, src_v, tok, pos, temps, active, ngen,
-                   budget, slot, n_prompt, first_tok, temperature,
-                   max_toks):
+                   budget, seeds, slot, n_prompt, first_tok, temperature,
+                   max_toks, seed, ngen0):
             """Insert a prefilled request into slot ``slot``.
             ``first_tok`` is a [1] device array — admission never reads it
             back to the host; the token value travels to the client via the
             inflight pipeline. The termination state is armed here too:
             the slot's budget vector entry gets the request's max_tokens,
-            its generated-count resets to 1 (the admission-sampled first
-            token), and the device-live mask arms unless the budget is
-            already spent by that first token."""
+            its generated-count is set to ``ngen0`` (1 for a fresh
+            admission — the admission-sampled first token; the
+            generated-so-far count for a containment replay, which is
+            what re-aligns the per-request RNG stream), its sampling
+            seed lands in the seeds vector, and the device-live mask
+            arms unless the budget is already spent."""
             with jax.named_scope("kv_splice"):
                 k = kv_slot_update(cache.k, src_k, slot)
                 v = kv_slot_update(cache.v, src_v, slot)
@@ -550,45 +667,35 @@ class BatchedJaxEngine(JaxEngine):
                 tok = tok.at[slot, 0].set(first_tok[0])
                 pos = pos.at[slot, 0].set(n_prompt)
                 temps = temps.at[slot].set(temperature)
-                active = active.at[slot].set(max_toks > 1)
-                ngen = ngen.at[slot].set(1)
+                active = active.at[slot].set(max_toks > ngen0)
+                ngen = ngen.at[slot].set(ngen0)
                 budget = budget.at[slot].set(max_toks)
+                seeds = seeds.at[slot].set(seed)
             return (KVCache(k=k, v=v, lengths=lengths), tok, pos, temps,
-                    active, ngen, budget)
+                    active, ngen, budget, seeds)
 
         self._splice_fn = jax.jit(splice,
-                                  donate_argnums=(0, 3, 4, 5, 6, 7, 8))
+                                  donate_argnums=(0, 3, 4, 5, 6, 7, 8, 9))
         self._batch_admit_fns = {}   # (kind, *shape) -> jitted program
         self._batch_ready = set()    # (kpad, sbucket, kv_limit) compiled
         self._S_alloc = S_alloc
 
-        # Device-side scheduler state. Under a serving mesh, slots shard
-        # over ``data`` and KV heads over ``model`` (parallel/sharding.py);
-        # the jitted chunk/splice programs inherit these shardings, so XLA
-        # places the TP/EP collectives and the donated buffers never move.
-        self._cache = self._new_cache(N, S_alloc)
-        self._tok_d = jnp.zeros((N, 1), jnp.int32)
-        self._pos_d = jnp.zeros((N, 1), jnp.int32)
-        self._temps_d = jnp.zeros((N,), jnp.float32)
-        # Device-resident termination state: live mask, cumulative
-        # completion-token counts, and per-slot token budgets. Carried
-        # (donated) through every chunk so a slot that finishes inside
-        # chunk N is already frozen in speculative chunks N+1.. without
-        # any host involvement; splice re-arms all three on admission.
-        self._active_d = jnp.zeros((N,), jnp.bool_)
-        self._ngen_d = jnp.zeros((N,), jnp.int32)
-        self._budget_d = jnp.ones((N,), jnp.int32)
-        if self.mesh is not None:
-            from ..parallel.sharding import shard_tokens
-
-            self._tok_d = shard_tokens(self._tok_d, self.mesh)
-            self._pos_d = shard_tokens(self._pos_d, self.mesh)
-            self._temps_d = shard_tokens(self._temps_d, self.mesh)
-            self._active_d = shard_tokens(self._active_d, self.mesh)
-            self._ngen_d = shard_tokens(self._ngen_d, self.mesh)
-            self._budget_d = shard_tokens(self._budget_d, self.mesh)
+        # Device-side scheduler state (slot vectors + KV cache) — built
+        # by _init_decode_state so the fault-containment reset path
+        # re-initializes EXACTLY what startup initialized. Under a
+        # serving mesh, slots shard over ``data`` and KV heads over
+        # ``model`` (parallel/sharding.py); the jitted chunk/splice
+        # programs inherit these shardings, so XLA places the TP/EP
+        # collectives and the donated buffers never move.
+        self._init_decode_state()
         self._key_d = jax.random.PRNGKey(self.seed)
         self._slots: List[Optional[_Slot]] = [None] * N
+        # Created HERE, not at worker-loop entry: a supervisor restart
+        # replays survivors (which may enqueue "first" pipeline entries)
+        # BEFORE the new loop thread runs — a loop-entry reset would
+        # silently drop those entries and lose each replayed admission's
+        # first token.
+        self._inflight: List[tuple] = []
 
         # Warm-up: smallest prefill bucket + the decode chunk + splice.
         b = self.prefill_buckets[0]
@@ -605,20 +712,25 @@ class BatchedJaxEngine(JaxEngine):
             jnp.asarray(0.0, jnp.float32),
         )
         (self._cache, self._tok_d, self._pos_d, self._temps_d,
-         self._active_d, self._ngen_d, self._budget_d) = self._splice_fn(
+         self._active_d, self._ngen_d, self._budget_d,
+         self._seeds_d) = self._splice_fn(
             self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
             self._temps_d, self._active_d, self._ngen_d, self._budget_d,
+            self._seeds_d,
             jnp.asarray(0, jnp.int32),
             jnp.asarray(1, jnp.int32), jnp.zeros((1,), jnp.int32),
             jnp.asarray(0.0, jnp.float32), jnp.asarray(1, jnp.int32),
+            jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32),
         )
         for kv_b in self._kv_buckets:
-            (packed, self._tok_d, self._pos_d, self._cache, self._key_d,
+            (packed, self._tok_d, self._pos_d, self._cache,
              self._active_d, self._ngen_d) = (
                 self._batch_chunk_fns[kv_b](
                     self.params, self._tok_d, self._pos_d, self._cache,
-                    self._key_d, self._temps_d, jnp.zeros((N,), jnp.bool_),
-                    self._active_d, self._ngen_d, self._budget_d)
+                    self._seeds_d, self._temps_d,
+                    jnp.zeros((N,), jnp.bool_),
+                    self._active_d, self._ngen_d, self._budget_d,
+                    self._no_corrupt_d)
             )
         # Warm the batched-admission programs. Group scratch is allocated
         # at SUFFIX depth now — kv_limit positions (prefix + suffix bucket,
@@ -656,21 +768,24 @@ class BatchedJaxEngine(JaxEngine):
                         self.params, jnp.zeros((kpad, sbucket), jnp.int32),
                         jnp.broadcast_to(spos, (kpad, sbucket)),
                         scratch2, jnp.ones((kpad, sbucket), jnp.float32),
-                        jnp.ones((kpad,), jnp.int32), self._key_d,
+                        jnp.ones((kpad,), jnp.int32),
+                        jnp.zeros((kpad,), jnp.int32),
                         jnp.zeros((kpad,), jnp.float32),
                     )
                     # All rows out-of-bounds: exercises the program, splices
                     # nothing.
                     (self._cache, self._tok_d, self._pos_d, self._temps_d,
-                     self._active_d, self._ngen_d, self._budget_d) = (
+                     self._active_d, self._ngen_d, self._budget_d,
+                     self._seeds_d) = (
                         self._get_batch_splice_fn(kpad)(
                             self._cache, scratch2.k, scratch2.v, self._tok_d,
                             self._pos_d, self._temps_d, self._active_d,
-                            self._ngen_d, self._budget_d,
+                            self._ngen_d, self._budget_d, self._seeds_d,
                             jnp.full((kpad,), N, jnp.int32),
                             jnp.zeros((kpad,), jnp.int32), ft,
                             jnp.zeros((kpad,), jnp.float32),
                             jnp.ones((kpad,), jnp.int32),
+                            jnp.zeros((kpad,), jnp.int32),
                         )
                     )
                     del scratch2
@@ -686,9 +801,16 @@ class BatchedJaxEngine(JaxEngine):
 
         self._running = True
         self._worker = threading.Thread(
-            target=self._worker_loop, name="batch-scheduler", daemon=True
+            target=self._worker_main, name="batch-scheduler", daemon=True
         )
         self._worker.start()
+        # Scheduler-death supervision: a separate thread that notices the
+        # scheduler thread dying (an uncatchable fault — scheduler:die in
+        # drills, a segfaulting extension call in the wild would take the
+        # process, but a raised BaseException lands here) and restarts it
+        # after a reset-and-replay, dropping zero queued requests.
+        threading.Thread(target=self._supervise_scheduler,
+                         name="batch-supervisor", daemon=True).start()
         if self.watchdog_secs > 0:
             threading.Thread(target=self._watchdog_loop, name="batch-watchdog",
                              daemon=True).start()
@@ -696,6 +818,46 @@ class BatchedJaxEngine(JaxEngine):
             "Batched engine ready: %s ×%d slots, chunk=%d, %.1fs",
             cfg.name, N, self.chunk_len, time.monotonic() - t0,
         )
+
+    def _init_decode_state(self) -> None:
+        """(Re-)initialize the device-resident scheduler state: the slot
+        KV cache, token/position vectors, per-slot temperature, the
+        device-termination carry (live mask / generated counts / token
+        budgets), the per-request sampling-seed vector, and the all-clear
+        decode:nan corruption mask. Called once at startup and again by
+        the fault-containment reset path (_reset_decode_state) — one
+        function so a reset can never drift from a fresh start."""
+        N = self.batch_size
+        self._cache = self._new_cache(N, self._S_alloc)
+        self._tok_d = jnp.zeros((N, 1), jnp.int32)
+        self._pos_d = jnp.zeros((N, 1), jnp.int32)
+        self._temps_d = jnp.zeros((N,), jnp.float32)
+        # Device-resident termination state: live mask, cumulative
+        # completion-token counts, and per-slot token budgets. Carried
+        # (donated) through every chunk so a slot that finishes inside
+        # chunk N is already frozen in speculative chunks N+1.. without
+        # any host involvement; splice re-arms all of these on admission.
+        self._active_d = jnp.zeros((N,), jnp.bool_)
+        self._ngen_d = jnp.zeros((N,), jnp.int32)
+        self._budget_d = jnp.ones((N,), jnp.int32)
+        # Per-request sampling seeds (set at splice time): every decode
+        # step samples slot i under fold_in(PRNGKey(seeds[i]), ngen[i]),
+        # the replay-parity contract (engine/sampling.py slot_keys).
+        self._seeds_d = jnp.zeros((N,), jnp.int32)
+        # decode:nan fault seam — all-False in normal serving; a drill
+        # dispatch swaps in a mask that NaNs the target slot's logits.
+        self._no_corrupt_d = jnp.zeros((N,), jnp.bool_)
+        if self.mesh is not None:
+            from ..parallel.sharding import shard_tokens
+
+            self._tok_d = shard_tokens(self._tok_d, self.mesh)
+            self._pos_d = shard_tokens(self._pos_d, self.mesh)
+            self._temps_d = shard_tokens(self._temps_d, self.mesh)
+            self._active_d = shard_tokens(self._active_d, self.mesh)
+            self._ngen_d = shard_tokens(self._ngen_d, self.mesh)
+            self._budget_d = shard_tokens(self._budget_d, self.mesh)
+            self._seeds_d = shard_tokens(self._seeds_d, self.mesh)
+            self._no_corrupt_d = shard_tokens(self._no_corrupt_d, self.mesh)
 
     def _warm_batch_admit_shapes(self) -> None:
         """Background-compile group-admission programs for the non-smallest
@@ -716,7 +878,6 @@ class BatchedJaxEngine(JaxEngine):
             from .prefix_cache import round_kv_limit
 
             P = self._prefix.n
-            key = jax.random.PRNGKey(1)
             for sbucket in self.prefill_buckets[1:]:
                 kvl = round_kv_limit(P + sbucket, self.max_seq_len)
                 if kvl is None:
@@ -756,7 +917,7 @@ class BatchedJaxEngine(JaxEngine):
                                 jax.ShapeDtypeStruct((kpad, sbucket),
                                                      jnp.float32),
                                 jax.ShapeDtypeStruct((kpad,), jnp.int32),
-                                jax.ShapeDtypeStruct(key.shape, key.dtype),
+                                jax.ShapeDtypeStruct((kpad,), jnp.int32),
                                 jax.ShapeDtypeStruct((kpad,), jnp.float32),
                             ).compile()
                         except Exception:  # pragma: no cover - best-effort
@@ -781,7 +942,8 @@ class BatchedJaxEngine(JaxEngine):
                             jnp.zeros((kpad, sbucket), jnp.int32),
                             jnp.broadcast_to(spos, (kpad, sbucket)),
                             scratch, jnp.ones((kpad, sbucket), jnp.float32),
-                            jnp.ones((kpad,), jnp.int32), key,
+                            jnp.ones((kpad,), jnp.int32),
+                            jnp.zeros((kpad,), jnp.int32),
                             jnp.zeros((kpad,), jnp.float32),
                         )
                         ft.block_until_ready()
@@ -816,10 +978,12 @@ class BatchedJaxEngine(JaxEngine):
                 jax.ShapeDtypeStruct((N,), jnp.bool_),
                 jax.ShapeDtypeStruct((N,), jnp.int32),
                 jax.ShapeDtypeStruct((N,), jnp.int32),
+                jax.ShapeDtypeStruct((N,), jnp.int32),
                 jax.ShapeDtypeStruct((kpad,), jnp.int32),
                 jax.ShapeDtypeStruct((kpad,), jnp.int32),
                 jax.ShapeDtypeStruct((kpad,), jnp.int32),
                 jax.ShapeDtypeStruct((kpad,), jnp.float32),
+                jax.ShapeDtypeStruct((kpad,), jnp.int32),
                 jax.ShapeDtypeStruct((kpad,), jnp.int32),
             ).compile()
         except Exception:  # pragma: no cover - best-effort
@@ -845,6 +1009,7 @@ class BatchedJaxEngine(JaxEngine):
                             for s in getattr(self, "_slots", ()))
                         or not self._admissions.empty()
                         or self._admitting > 0
+                        or bool(getattr(self, "_parked", ()))
                         or bool(getattr(self, "_inflight", ())))
                 # A concurrent stop(0) — the second-signal force path —
                 # sets _shutdown mid-drain; stop waiting immediately.
@@ -916,6 +1081,13 @@ class BatchedJaxEngine(JaxEngine):
             "chunks_consumed": self._chunks_consumed,
             "chunks_pruned": self._chunks_pruned,
             "chunk_fetch_secs": fetch_samples,
+            # Fault-containment totals (ISSUE 5): resets by cause,
+            # quarantines by reason, health trips, replayed tokens —
+            # delta-mirrored into Prometheus at scrape time
+            # (Metrics.observe_containment) and surfaced in /health.
+            "containment": dict(self.supervisor.stats(),
+                                parked=len(self._parked),
+                                slot_health_check=self.slot_health_check),
         }
 
     #: finish timestamps older than this don't feed the drain-rate
@@ -965,10 +1137,26 @@ class BatchedJaxEngine(JaxEngine):
         # chunks later — ordering stays linear because everything chains
         # through donated buffers. Only "chunk" entries count against the
         # pipeline depth; first-token entries are transfers, not compute.
-        self._inflight = []
+        # (self._inflight is created at startup and deliberately NOT
+        # reset here: a supervisor restart may already have queued
+        # replayed admissions' first-token entries.)
         while self._running:
             try:
+                if self.faults is not None:
+                    # scheduler:die — raises a BaseException the except
+                    # below can't catch: this thread dies for real, and
+                    # _supervise_scheduler's restart is what recovers.
+                    self.faults.check_scheduler_die()
                 self._last_progress = time.monotonic()
+                # Bisection probation: the parked half is exonerated when
+                # the probe group fully drains (no slots, no pipeline) —
+                # or earlier, after PROBATION_CLEAN_CHUNKS clean chunks in
+                # _consume_oldest, so long-generation probes don't stall
+                # admissions for their whole remaining decode.
+                if (self._parked and not self._inflight
+                        and all(s is None for s in self._slots)):
+                    self._unpark_parked()
+                    continue
                 self._admit_pending()
                 self._sweep_finishes()
                 n_active = sum(
@@ -1031,31 +1219,342 @@ class BatchedJaxEngine(JaxEngine):
                 except _queue.Empty:
                     continue
                 self._admitting += 1
+                self._admitting_reqs.append(req)
                 try:
                     self._admit_popped([req])
                 finally:
                     self._admitting -= 1
-            except Exception:  # pragma: no cover - scheduler must survive
-                logger.exception("batch scheduler error; failing active slots")
-                self._inflight.clear()
-                for i, slot in enumerate(self._slots):
-                    if slot is not None:
-                        self._finish(i, "abort",
-                                     error=EngineUnavailable("scheduler error"))
+            except Exception as e:
+                # The step is POISONED, not the engine: before ISSUE 5 this
+                # path failed every active slot — one bad request (or one
+                # flaky device step) took down the whole batch. Now the
+                # containment pass quarantines the culprit (bisecting when
+                # the fault names no slot) and reset-and-replays the
+                # innocent survivors; only an exhausted reset budget falls
+                # back to the old fail-everything behaviour.
+                logger.exception("batch scheduler step poisoned; "
+                                 "running containment")
+                try:
+                    self._contain_poisoned_step(CAUSE_SCHEDULER_ERROR,
+                                                error=e)
+                except Exception:  # pragma: no cover - containment itself
+                    logger.exception("containment failed; failing active "
+                                     "slots")
+                    self._fail_all_active(
+                        EngineUnavailable("scheduler error"))
         # Shutdown: fail everything still holding a coroutine — active
-        # slots (their in-flight chunks are abandoned) and queued
-        # admissions — so no generate() call blocks forever.
-        self._inflight.clear()
-        for i, slot in enumerate(self._slots):
-            if slot is not None:
-                self._finish(i, "abort",
-                             error=EngineUnavailable("engine stopped"))
+        # slots (their in-flight chunks are abandoned), parked probation
+        # slots, and queued admissions — so no generate() call blocks
+        # forever.
+        self._fail_all_active(EngineUnavailable("engine stopped"))
         while True:
             try:
                 req = self._admissions.get_nowait()
             except _queue.Empty:
                 break
             self._emit(req, "error", EngineUnavailable("engine stopped"))
+
+    def _worker_main(self) -> None:
+        """Scheduler-thread entry: runs the loop and, when the loop dies
+        of an uncatchable fault (BaseException — the poisoned-step
+        containment inside the loop handles every Exception), lets the
+        thread exit so _supervise_scheduler notices the corpse and
+        restarts it. Never re-raises: a dead scheduler is a recoverable
+        engine event, not a process event."""
+        try:
+            self._worker_loop()
+        except BaseException:
+            logger.critical(
+                "batch scheduler thread died; supervisor will restart it",
+                exc_info=True)
+
+    # ------------------------------------------- containment (ISSUE 5)
+
+    def set_reset_listener(self, fn) -> None:
+        """Wire engine resets to the service layer (the PR 1 breaker):
+        ``fn(cause)`` runs after every recorded reset, so a flapping
+        engine opens the breaker even while individual requests keep
+        recovering."""
+        self.supervisor.on_reset = fn
+
+    def _fail_all_active(self, error: BaseException) -> None:
+        """The pre-containment blast radius — every active, parked, and
+        (NOT queued — those stay) request fails. Only reached when
+        containment itself is out of budget or broken."""
+        self._inflight.clear()
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._finish(i, "abort", error=error)
+        for slot in self._parked:
+            self._emit(slot.req, "error", error)
+        self._parked.clear()
+
+    def _contain_poisoned_step(self, cause: str, named=(),
+                               error: Optional[BaseException] = None) -> None:
+        """The quarantine + reset-and-replay pass (scheduler thread).
+
+        ``named`` lists slots the device health word implicated (the
+        culprit is known); empty means a step-wide fault (exception in a
+        scheduler step / poisoned fetch) where the culprit is unknown
+        and bisection does the isolating: replay half the survivors,
+        park the rest, recurse on whichever half poisons again. A slot
+        solo-implicated past its retry budget is failed terminally with
+        RequestQuarantined (410 — the engine is fine, THAT request is
+        not); everyone else is re-spliced from prompt + generated-so-far
+        prefix and replayed under its recorded sampling seed, so
+        recovered transcripts are bit-identical to a fault-free run.
+        Queued admissions are untouched throughout — a reset drops zero
+        queued requests."""
+        survivors = [s for s in self._slots if s is not None]
+        if not self.supervisor.allow_reset():
+            # Reset budget exhausted (ENGINE_RESET_MAX_PER_MIN): stop
+            # resetting — a flapping engine must degrade, not thrash.
+            # Failing the affected requests feeds the PR 1 breaker,
+            # which is the designed next ring out.
+            logger.critical(
+                "engine reset budget exhausted (%d/min); failing %d "
+                "slot(s) instead of resetting again",
+                self.supervisor.max_resets_per_min, len(survivors))
+            self._fail_all_active(error if isinstance(error, Exception)
+                                  else EngineUnavailable(
+                                      "engine reset budget exhausted"))
+            return
+
+        # Culprit isolation. Health-named suspects are implicated
+        # directly; an un-named fault whose suspect pool is down to one
+        # request has bisected to its culprit. Either way the retry
+        # budget decides quarantine-now vs one-more-replay (a transient
+        # device fault must not kill an innocent request on first trip).
+        quarantined: List[_Slot] = []
+        reasons: dict = {}
+        pool = list(survivors)
+        if named:
+            for slot in named:
+                if self.supervisor.implicate(slot.req):
+                    quarantined.append(slot)
+                    reasons[id(slot)] = REASON_HEALTH
+        else:
+            # Narrow to the standing suspect pool: after an early
+            # exoneration the batch re-mixes cleared cohabitants (and new
+            # admissions) with the still-suspect half, and only the
+            # latter should keep bisecting. No flags standing (or a stale
+            # pool that already drained) means everyone is suspect.
+            flagged = [s for s in survivors if s.req.suspect]
+            if flagged:
+                pool = flagged
+            if len(pool) == 1:
+                slot = pool[0]
+                if self.supervisor.implicate(slot.req):
+                    quarantined.append(slot)
+                    reasons[id(slot)] = REASON_ISOLATED
+
+        # Tear down: slots detach, the speculative pipeline drops, and
+        # the device state is rebuilt exactly as startup built it.
+        self._slots = [None] * self.batch_size
+        self._inflight.clear()
+        self._reset_decode_state()
+        self.supervisor.note_reset(cause)
+
+        qset = {id(s) for s in quarantined}
+        for slot in quarantined:
+            reason = reasons[id(slot)]
+            self.supervisor.note_quarantine(reason)
+            if slot.req.trace is not None:
+                slot.req.trace.event(
+                    f"engine: quarantined ({reason}, "
+                    f"suspected {slot.req.suspect_count}x, "
+                    f"{len(slot.detok.ids)} tokens generated)")
+            self._finish_times.append(time.monotonic())
+            self._emit(slot.req, "error", RequestQuarantined(
+                f"request quarantined after poisoning {cause} "
+                f"{slot.req.suspect_count}x (retry budget "
+                f"{self.supervisor.retry_budget})"))
+
+        rest = [s for s in survivors
+                if id(s) not in qset and not s.req.cancel.is_set()]
+        if named:
+            probe, parked = rest, []
+        else:
+            # Step-wide fault: bisect WITHIN the suspect pool only —
+            # replay one half of it, park the other, and replay every
+            # non-suspect (exonerated cohabitant / post-fault admission)
+            # immediately alongside the probe. If the probe poisons
+            # again, this pass recurses on the halved pool; if it runs
+            # PROBATION_CLEAN_CHUNKS clean chunks (or drains), suspicion
+            # narrows to the parked half and it unparks.
+            pool_rest = [s for s in pool
+                         if id(s) not in qset and not s.req.cancel.is_set()]
+            pool_ids = {id(s) for s in pool_rest}
+            innocents = [s for s in rest if id(s) not in pool_ids]
+            if len(pool_rest) <= 1:
+                probe, parked = rest, []
+            else:
+                probe_sus, parked = EngineSupervisor.split(pool_rest)
+                probe = probe_sus + innocents
+            for s in innocents:
+                s.req.suspect = False
+            for s in pool_rest:
+                s.req.suspect = True
+        logger.warning(
+            "engine reset (%s): %d survivor(s) — %d quarantined, "
+            "%d replaying, %d parked for bisection",
+            cause, len(survivors), len(quarantined), len(probe),
+            len(parked))
+        self._parked.extend(parked)
+        self._probation_clean = 0   # each containment pass restarts probation
+        for slot in parked:
+            if slot.req.trace is not None:
+                slot.req.trace.event(
+                    "engine: parked for culprit bisection")
+        for slot in probe:
+            self._guarded_replay(slot)
+
+    def _unpark_parked(self) -> None:
+        """End bisection probation: replay every parked slot (each
+        resumes from its generated-so-far prefix) and let admissions
+        resume on the next loop pass."""
+        parked, self._parked = self._parked, []
+        self._probation_clean = 0
+        for slot in parked:
+            self._guarded_replay(slot)
+
+    def _reset_decode_state(self) -> None:
+        """Rebuild every device-resident buffer from scratch. The old
+        buffers may be donated-away or poisoned (NaN KV rows) — nothing
+        is salvaged; replay re-derives per-slot state from host truth
+        (prompt + emitted tokens + seed)."""
+        self._init_decode_state()
+        self._last_progress = time.monotonic()
+
+    def _guarded_replay(self, slot: "_Slot") -> None:
+        """Replay one surviving slot; a failing replay (OOM, fault drill
+        hitting the admission path) errors THAT request only."""
+        try:
+            self._replay_slot(slot)
+        except Exception:
+            logger.exception("replay failed; failing the request")
+            self._emit(slot.req, "error",
+                       EngineUnavailable("replay after engine reset failed"))
+
+    def _replay_slot(self, slot: "_Slot") -> None:
+        """Re-splice one surviving request from prompt + generated-so-far
+        prefix: prefill(prompt ++ emitted[:-1]), force the carry token to
+        the last emitted id, and re-arm the device vectors with
+        ngen = len(emitted) — the per-request seed stream then continues
+        at exactly the generation index a fault-free run would be at, so
+        the remaining tokens are bit-identical. The slot object (detok
+        state, timings, trace) is reused: nothing already streamed to the
+        client is re-emitted.
+
+        Numerics caveat: the replay rebuilds the emitted tokens' KV via
+        one batched prefill where the original run built it step-by-step
+        in decode. Bit-identity therefore also rests on prefill/decode
+        producing the same floats for the same positions — exact here
+        (f32 CPU/TPU tests) but a last-ULP logit difference under e.g.
+        bf16 matmul reduction reordering could flip a near-tie pick
+        (same numerics class as the int8-KV argmax-flip xfail)."""
+        req = slot.req
+        if req.cancel.is_set():
+            return
+        if req.deadline is not None and time.monotonic() > req.deadline:
+            self._emit(req, "error",
+                       GenerationTimeout("generation timeout"))
+            return
+        ids = list(slot.detok.ids)
+        if not ids:
+            # Nothing emitted yet (the admission's first token was still
+            # in the dropped pipeline): a fresh admission reproduces the
+            # original run exactly — the first token samples at index 0
+            # of the same seed stream.
+            self._admit_one(req)
+            return
+        g = len(ids)
+        slot_idx = self._slots.index(None)
+        replay_ids = list(req.prompt_ids) + ids[:-1]
+        last_logits, scratch, n_total, _ = self._prefill_prompt(
+            replay_ids, max(1, req.max_tokens - g))
+        del last_logits  # the next token is sampled in-chunk, not here
+        (self._cache, self._tok_d, self._pos_d, self._temps_d,
+         self._active_d, self._ngen_d, self._budget_d,
+         self._seeds_d) = self._splice_fn(
+            self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
+            self._temps_d, self._active_d, self._ngen_d, self._budget_d,
+            self._seeds_d,
+            jnp.asarray(slot_idx, jnp.int32),
+            jnp.asarray(n_total, jnp.int32),
+            jnp.asarray([ids[-1]], jnp.int32),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.max_tokens, jnp.int32),
+            jnp.asarray(req.seed, jnp.int32),
+            jnp.asarray(g, jnp.int32),
+        )
+        slot.pos = n_total
+        slot.chunks_inflight = 0
+        slot.decode_chunks_inflight = 0
+        slot.exhausted = n_total >= self.max_seq_len
+        self._slots[slot_idx] = slot
+        self.supervisor.note_replay(g)
+        if req.trace is not None:
+            req.trace.event(
+                f"engine: replayed into slot {slot_idx} from {g} "
+                f"generated tokens (seed {req.seed})")
+        self._last_admit_t = time.monotonic()
+
+    def _supervise_scheduler(self) -> None:
+        """Watch for scheduler-thread DEATH (the watchdog watches for
+        scheduler HANG). A dead scheduler — scheduler:die in drills, an
+        uncatchable error in the wild — is recovered exactly like a
+        poisoned step: reset, replay survivors, restart the loop thread.
+        Queued admissions live in a thread-safe queue the dead thread
+        never drained, so zero queued requests are dropped."""
+        while self._running:
+            time.sleep(0.2)
+            worker = self._worker
+            if (not self._running or self._stopping or worker is None
+                    or worker.is_alive()):
+                continue
+            survivors = [s for s in self._slots if s is not None]
+            if not self.supervisor.allow_reset():
+                logger.critical(
+                    "scheduler dead and reset budget exhausted; "
+                    "marking engine degraded")
+                self._ready = False
+                err = EngineUnavailable(
+                    "scheduler dead; engine reset budget exhausted")
+                self._fail_all_active(err)
+                for req in self._admitting_reqs:
+                    self._emit(req, "error", err)
+                self._admitting_reqs.clear()
+                while True:
+                    try:
+                        req = self._admissions.get_nowait()
+                    except _queue.Empty:
+                        break
+                    self._emit(req, "error", err)
+                return
+            logger.critical("batch scheduler thread dead; resetting decode "
+                            "state and restarting it (%d survivor(s))",
+                            len(survivors))
+            # Requeue requests the dead thread had popped but not yet
+            # settled (mid-admission when it died): they hold no slot and
+            # no generated tokens, so a fresh admission is a correct
+            # replay. Skip any that DID reach a slot before the death —
+            # those ride the survivor replay below.
+            slotted = {id(s.req) for s in survivors}
+            for req in self._admitting_reqs:
+                if id(req) not in slotted:
+                    self._admissions.put(req)
+            self._admitting_reqs.clear()
+            self._slots = [None] * self.batch_size
+            self._inflight.clear()
+            self._reset_decode_state()
+            self.supervisor.note_reset(CAUSE_SCHEDULER_DEATH)
+            for slot in survivors:
+                self._guarded_replay(slot)
+            self._worker = threading.Thread(
+                target=self._worker_main, name="batch-scheduler",
+                daemon=True)
+            self._worker.start()
 
     #: batched-admission group sizes (pow2-padded); cap bounds the scratch
     #: KV memory (kpad × S_alloc slots) and the compile variety.
@@ -1142,6 +1641,12 @@ class BatchedJaxEngine(JaxEngine):
         2B model (round-3 profiling; also fixes round-2 weak #8's
         admission-burst latency spike). Everything else (full prefill,
         chunked/ring long prompts) takes the single-request path."""
+        if self._parked:
+            # Bisection probation: only the probe group may occupy slots
+            # — a new admission joining a suspect batch would muddy the
+            # culprit attribution. Queued requests simply wait (and are
+            # never dropped); probation lasts at most a few chunks.
+            return
         free = sum(s is None for s in self._slots)
         pending = []
         while len(pending) < free:
@@ -1156,6 +1661,7 @@ class BatchedJaxEngine(JaxEngine):
         # (stop(drain_secs)) doesn't tear down under an admission whose
         # cold prefill can run for seconds on this thread.
         self._admitting += len(pending)
+        self._admitting_reqs.extend(pending)
         try:
             self._admit_popped(pending)
         finally:
@@ -1179,6 +1685,15 @@ class BatchedJaxEngine(JaxEngine):
                 for req in reqs:
                     self._emit(req, "error",
                                EngineUnavailable("admission failed"))
+            # Settled (slotted or errored) either way — drop the mid-
+            # admission record. A BaseException skips this on purpose:
+            # the record is what lets _supervise_scheduler recover the
+            # request after the thread dies.
+            for req in reqs:
+                try:
+                    self._admitting_reqs.remove(req)
+                except ValueError:  # pragma: no cover - defensive
+                    pass
 
         groups: dict = {}
         singles: List[_Request] = []
@@ -1253,7 +1768,7 @@ class BatchedJaxEngine(JaxEngine):
             impl = self._prefill_impl_for(sbucket, kv_limit)
 
             def batch_suffix(params, tokens, positions, cache, mask,
-                             lengths, key, temperatures):
+                             lengths, seeds, temperatures):
                 # logits_at: the LM head projects ONLY each row's last
                 # valid position — a [kpad, sbucket, 256k-vocab] f32
                 # activation here measured as an HBM OOM on the 7B bench
@@ -1264,10 +1779,15 @@ class BatchedJaxEngine(JaxEngine):
                                         moe_impl=self.moe_impl,
                                         token_mask=mask,
                                         logits_at=lengths - 1)
-                first = sample_tokens_batched(logits[:, 0], key,
-                                              temperatures,
-                                              top_k=self.top_k,
-                                              top_p=self.top_p)
+                # First tokens sample at generation index 0 of each row's
+                # per-request seed stream — identical to the single
+                # admission path, so group vs single admission can never
+                # diverge a sampled transcript.
+                first = sample_tokens_seeded(logits[:, 0], seeds,
+                                             jnp.zeros_like(seeds),
+                                             temperatures,
+                                             top_k=self.top_k,
+                                             top_p=self.top_p)
                 return first, cache
 
             fn = jax.jit(batch_suffix, donate_argnums=(3,))
@@ -1282,8 +1802,8 @@ class BatchedJaxEngine(JaxEngine):
         fn = self._batch_admit_fns.get(key)
         if fn is None:
             def splice_many(cache, src_k, src_v, tok, pos, temps, active,
-                            ngen, budget, slots, n_prompts, first_toks,
-                            temperatures, max_toks):
+                            ngen, budget, seeds, slots, n_prompts,
+                            first_toks, temperatures, max_toks, req_seeds):
                 with jax.named_scope("kv_splice"):
                     k = kv_set_slots(cache.k, src_k, slots)
                     v = kv_set_slots(cache.v, src_v, slots)
@@ -1295,10 +1815,12 @@ class BatchedJaxEngine(JaxEngine):
                     active = active.at[slots].set(max_toks > 1, mode="drop")
                     ngen = ngen.at[slots].set(1, mode="drop")
                     budget = budget.at[slots].set(max_toks, mode="drop")
+                    seeds = seeds.at[slots].set(req_seeds, mode="drop")
                 return (KVCache(k=k, v=v, lengths=lengths), tok, pos, temps,
-                        active, ngen, budget)
+                        active, ngen, budget, seeds)
 
-            fn = jax.jit(splice_many, donate_argnums=(0, 3, 4, 5, 6, 7, 8))
+            fn = jax.jit(splice_many,
+                         donate_argnums=(0, 3, 4, 5, 6, 7, 8, 9))
             self._batch_admit_fns[key] = fn
         return fn
 
@@ -1366,20 +1888,22 @@ class BatchedJaxEngine(JaxEngine):
         mask = np.zeros((kpad, sbucket), np.float32)
         suf_lens = np.ones((kpad,), np.int32)  # padding rows gather index 0
         temps = np.zeros((kpad,), np.float32)
+        seeds = np.zeros((kpad,), np.int32)
         for i, req in enumerate(live):
             suf = req.prompt_ids[prefix.n:]
             tokens[i, :len(suf)] = suf
             mask[i, :len(suf)] = 1.0
             suf_lens[i] = len(suf)
             temps[i] = req.temperature
+            seeds[i] = req.seed
         positions = np.broadcast_to(
             prefix.n + np.arange(sbucket), (kpad, sbucket)).astype(np.int32)
 
-        self._key_d, sub = jax.random.split(self._key_d)
         first_toks_d, scratch = self._get_batch_suffix_fn(
             kpad, sbucket, kv_limit)(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
-            scratch, jnp.asarray(mask), jnp.asarray(suf_lens), sub,
+            scratch, jnp.asarray(mask), jnp.asarray(suf_lens),
+            jnp.asarray(seeds),
             jnp.asarray(temps),
         )
 
@@ -1411,13 +1935,14 @@ class BatchedJaxEngine(JaxEngine):
             pairs.append((req, slot_idx))
 
         (self._cache, self._tok_d, self._pos_d, self._temps_d,
-         self._active_d, self._ngen_d, self._budget_d) = (
+         self._active_d, self._ngen_d, self._budget_d, self._seeds_d) = (
             self._get_batch_splice_fn(kpad)(
                 self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
                 self._temps_d, self._active_d, self._ngen_d, self._budget_d,
+                self._seeds_d,
                 jnp.asarray(slots_arr),
                 jnp.asarray(n_prompts), first_toks_d, jnp.asarray(temps),
-                jnp.asarray(budgets),
+                jnp.asarray(budgets), jnp.asarray(seeds),
             )
         )
         self._to_host_async(first_toks_d)
@@ -1445,18 +1970,25 @@ class BatchedJaxEngine(JaxEngine):
         last_logits, scratch, n_prompt, prefix_hit = self._prefill_prompt(
             req.prompt_ids, req.max_tokens
         )
-        self._key_d, sub = jax.random.split(self._key_d)
+        # First token = generation index 0 of the request's own seed
+        # stream (same key derivation as the in-chunk sampler), so a
+        # containment replay — or an offline reproduction from the seed
+        # in /debug/requests/{id} — regenerates it bit-identically.
+        first_key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
         first_tok_d = self._sample_fn(
-            last_logits, sub, jnp.asarray(req.temperature, jnp.float32)
+            last_logits, first_key, jnp.asarray(req.temperature, jnp.float32)
         )
         (self._cache, self._tok_d, self._pos_d, self._temps_d,
-         self._active_d, self._ngen_d, self._budget_d) = self._splice_fn(
+         self._active_d, self._ngen_d, self._budget_d,
+         self._seeds_d) = self._splice_fn(
             self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
             self._temps_d, self._active_d, self._ngen_d, self._budget_d,
+            self._seeds_d,
             jnp.asarray(slot_idx, jnp.int32), jnp.asarray(n_prompt, jnp.int32),
             first_tok_d,
             jnp.asarray(req.temperature, jnp.float32),
             jnp.asarray(req.max_tokens, jnp.int32),
+            jnp.asarray(req.seed, jnp.int32), jnp.asarray(1, jnp.int32),
         )
 
         slot = _Slot(
@@ -1559,12 +2091,35 @@ class BatchedJaxEngine(JaxEngine):
         # the capacity sweep stay conservative.
         needed = max(s.pos for s in active_slots) + self.chunk_len
         bucket = next(b for b in self._kv_buckets if b >= needed)
-        (packed_d, self._tok_d, self._pos_d, self._cache, self._key_d,
+        # decode:nan fault seam: normally the cached all-False mask; a
+        # drill swaps in a mask that NaNs the target slot's logits inside
+        # the jitted chunk so the REAL device-side health detection (and
+        # everything downstream of it) is what gets exercised.
+        corrupt_d = self._no_corrupt_d
+        if self.faults is not None:
+            hits = self.faults.decode_nan_slots([
+                s.req.prompt if s is not None and not s.exhausted else None
+                for s in self._slots
+            ])
+            if hits:
+                mask = np.zeros((self.batch_size,), bool)
+                mask[hits] = True
+                corrupt_d = jnp.asarray(mask)
+                if self.mesh is not None:
+                    # Match _no_corrupt_d's sharding: the chunk program
+                    # was compiled against the data-sharded layout, and
+                    # an uncommitted single-device array would at best
+                    # reshard per faulted dispatch and at worst (jax
+                    # 0.4.37 XLA:CPU SPMD) run a different program than
+                    # the one production serving exercises.
+                    from ..parallel.sharding import shard_tokens
+                    corrupt_d = shard_tokens(corrupt_d, self.mesh)
+        (packed_d, self._tok_d, self._pos_d, self._cache,
          self._active_d, self._ngen_d) = (
             self._batch_chunk_fns[bucket](
                 self.params, self._tok_d, self._pos_d, self._cache,
-                self._key_d, self._temps_d, force, self._active_d,
-                self._ngen_d, self._budget_d)
+                self._seeds_d, self._temps_d, force, self._active_d,
+                self._ngen_d, self._budget_d, corrupt_d)
         )
         snapshot = [
             s.req if s is not None and not s.exhausted else None
@@ -1704,8 +2259,15 @@ class BatchedJaxEngine(JaxEngine):
                 self._consume_first(int(v), req, slot_idx)
             return
         _, packed_d, snapshot = entry
-        # THE per-chunk round trip: tokens, done mask, live lengths, and
-        # n_alive cross in one packed buffer / one fetch (protocol.py).
+        if self.faults is not None:
+            # decode:poison_step — a step-wide fault thrown from the
+            # chunk fetch (no slot named): the widened scheduler except
+            # routes it into the bisecting containment pass.
+            self.faults.poison_fetch(
+                [r.prompt if r is not None else None for r in snapshot])
+        # THE per-chunk round trip: tokens, done mask, live lengths,
+        # health, and n_alive cross in one packed buffer / one fetch
+        # (protocol.py v2).
         t_fetch = time.monotonic()
         res = unpack_chunk(self._fetch(packed_d), self.batch_size,
                            self.chunk_len)
@@ -1718,6 +2280,33 @@ class BatchedJaxEngine(JaxEngine):
             "fetch_ms": round(fetch_s * 1000.0, 3),
             "pipe": sum(1 for e in self._inflight if e[0] == "chunk"),
         })
+        # Slot-health quarantine (ISSUE 5): a tripped health bit names
+        # its culprit directly. NOTHING from a poisoned chunk is emitted
+        # — innocents' rows are valid, but replay regenerates them
+        # bit-identically (seeded sampling), and dropping the whole chunk
+        # keeps "no corrupt token ever reaches a client" unconditional.
+        tripped = [
+            i for i in range(self.batch_size)
+            if int(res.health[i]) and snapshot[i] is not None
+            and self._slots[i] is not None
+            and self._slots[i].req is snapshot[i]
+        ]
+        if tripped:
+            self.supervisor.note_health_trips(len(tripped))
+            for i in tripped:
+                self._chunk_log.append({
+                    "t": time.time(), "event": "health_trip", "slot": i,
+                    "health": describe_health(int(res.health[i])),
+                })
+                slot = self._slots[i]
+                if slot.req.trace is not None:
+                    slot.req.trace.event(
+                        f"engine: slot {i} health tripped "
+                        f"({describe_health(int(res.health[i]))})")
+            self._contain_poisoned_step(
+                CAUSE_SLOT_HEALTH,
+                named=[self._slots[i] for i in tripped])
+            return
         cfg = self.model_cfg
         for i, slot in enumerate(self._slots):
             if slot is None or slot.req is not snapshot[i]:
@@ -1755,6 +2344,35 @@ class BatchedJaxEngine(JaxEngine):
                     f"n_alive={res.n_alive})")
             if finish is not None:
                 self._finish(i, finish)
+        # Early exoneration: the probe survived another clean chunk.
+        # After PROBATION_CLEAN_CHUNKS of them, suspicion narrows to the
+        # parked half, which replays NOW — instead of stalling admissions
+        # until the probe drains its whole remaining decode (minutes for
+        # long generations; queued requests would blow their timeouts).
+        # A chunk only counts as probation evidence if its snapshot held
+        # a flagged suspect — chunks dispatched before an unpark carry
+        # only already-cleared slots and prove nothing.
+        if any(r is not None and r.suspect for r in snapshot):
+            self._probation_clean += 1
+            if self._probation_clean >= PROBATION_CLEAN_CHUNKS:
+                self._probation_clean = 0
+                for s in self._slots:
+                    if s is not None:
+                        s.req.suspect = False
+                if self._parked:
+                    self._unpark_parked()
+                # else: the narrowed (re-mixed) suspects also ran clean —
+                # the fault was transient; case closed, so a later
+                # unrelated fault bisects from the full batch again.
+        elif self._parked and not any(
+                s is not None and s.req.suspect for s in self._slots
+        ) and not any(
+                r is not None and r.suspect
+                for e in self._inflight if e[0] == "chunk" for r in e[2]):
+            # Every probe suspect completed (exonerated by finishing) and
+            # none remains in the pipe: the parked half inherits the
+            # suspicion now rather than waiting out innocents' decode.
+            self._unpark_parked()
 
     def _finish(self, slot_idx: int, finish: str,
                 error: Optional[BaseException] = None,
@@ -1829,9 +2447,19 @@ class BatchedJaxEngine(JaxEngine):
     # ------------------------------------------------------------ serving
 
     async def _stream_events(self, prompt: str, *, max_tokens: int,
-                             temperature: float, timeout: Optional[float]):
+                             temperature: float, timeout: Optional[float],
+                             seed: Optional[int] = None):
         if not self._ready:
             raise EngineUnavailable("engine not started")
+        # Per-request sampling seed: explicit when the caller pins one,
+        # else minted deterministically from the prompt — either way the
+        # transcript is a pure function of (seed, prompt, settings),
+        # which containment replay AND offline reproduction rely on. The
+        # seed rides the trace into /debug/requests/{id}.
+        if seed is None:
+            seed = zlib.crc32(prompt.encode("utf-8", "surrogatepass")) \
+                & 0x7FFFFFFF
+        seed = int(seed) & 0x7FFFFFFF
         # Load shedding at submit time: beyond max_queue_depth every queued
         # request would wait multiple full batches for a slot — reject in
         # microseconds with a drain-rate-priced Retry-After rather than
@@ -1860,10 +2488,12 @@ class BatchedJaxEngine(JaxEngine):
             cancel=threading.Event(),
             t_submit=t_submit,
             trace=trace,
+            seed=seed,
+            prompt=prompt,
         )
         if trace is not None:
             trace.event(f"engine: submitted to batch scheduler "
-                        f"(queue depth {depth})")
+                        f"(queue depth {depth}, sampling seed {seed})")
         self._admissions.put(req)
         try:
             while True:

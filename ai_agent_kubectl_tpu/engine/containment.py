@@ -1,0 +1,185 @@
+"""Blast-radius containment for the continuous batcher (the INNER ring).
+
+PR 1 built the OUTER containment ring — bounded admission, circuit
+breaker, degraded fallback — which keeps a broken *engine* from taking
+down the *service*. This module is the inner ring: it keeps a broken
+*request* (or one flaky device step) from taking down the *engine*.
+Continuous batching colocates dozens of unrelated requests per decode
+step, so without it one poisoned request fails every cohabitant — and a
+long-decode victim loses hundreds of already-generated tokens.
+
+Three mechanisms, shared by ``BatchedJaxEngine`` and
+``FakeChunkedEngine`` (both schedulers call into one
+``EngineSupervisor``):
+
+1. **Detection** — the packed chunk contract (protocol.py v2) carries a
+   per-slot health word written device-side (NaN/Inf logits,
+   out-of-range sampled token ids), and the scheduler's step ``except``
+   marks the step *poisoned* instead of failing every slot.
+2. **Quarantine** — a culprit-isolation pass: a health bit names its
+   slot directly; a step-wide fault bisects (replay half the survivors,
+   park the rest) until the culprit runs alone. A confirmed culprit is
+   failed with a terminal 410-style ``RequestQuarantined`` once its
+   per-request ``QUARANTINE_RETRY_BUDGET`` is spent — never an infinite
+   replay loop.
+3. **Reset-and-replay** — decode state (KV cache, slot vectors, the
+   speculative chunk pipeline) is torn down and re-initialized, then
+   every surviving request is re-spliced from prompt + generated-so-far
+   prefix and replayed under its recorded per-request sampling seed
+   (engine/sampling.py ``slot_keys``), so recovered transcripts are
+   bit-identical to a fault-free run. Resets are rate-limited
+   (``ENGINE_RESET_MAX_PER_MIN``); past the limit the engine falls back
+   to the PR 1 fail-fast path whose errors open the breaker, and every
+   reset is also reported to the breaker through ``on_reset`` so a
+   flapping engine degrades gracefully instead of flapping forever.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: reset cause labels (the ``engine_resets_total{cause}`` label set —
+#: fixed here so metric cardinality is bounded by construction).
+CAUSE_SLOT_HEALTH = "slot_health"          # device health word tripped
+CAUSE_SCHEDULER_ERROR = "scheduler_error"  # exception in a scheduler step
+CAUSE_SCHEDULER_DEATH = "scheduler_death"  # scheduler thread/task died
+
+#: quarantine reason labels (``quarantined_requests_total{reason}``).
+REASON_HEALTH = "slot_health"      # repeatedly tripped the health word
+REASON_ISOLATED = "step_poison"    # bisect isolated it as the step poisoner
+
+#: Early exoneration for bisection probation: once the probe group has
+#: consumed this many chunks clean, the parked half is unparked and
+#: admissions resume WITHOUT waiting for the probe to drain to empty —
+#: otherwise one transient step-wide fault under long generations would
+#: stall every admission for the probe's whole remaining decode (minutes
+#: at max_tokens=512), converting a recovered fault into a service-wide
+#: timeout storm. The cost: an intermittent fault that next trips after
+#: re-mixing restarts bisection from the full survivor set — extra reset
+#: rounds (still budgeted by ENGINE_RESET_MAX_PER_MIN), never a wrong
+#: quarantine (terminal blame always requires solo implication or a
+#: health-named slot, under the per-request retry budget).
+PROBATION_CLEAN_CHUNKS = 2
+
+
+class EngineSupervisor:
+    """Reset/quarantine bookkeeping + policy for one engine instance.
+
+    The engine's scheduler calls in from its own thread (or task); all
+    mutation is behind one lock so ``stats()`` reads from the metrics
+    scrape path are coherent. The supervisor owns POLICY (budgets, rate
+    limit, counters); the MECHANISM of tearing down device state and
+    re-splicing requests stays in the engine, which knows its buffers.
+    """
+
+    def __init__(self, *, retry_budget: int = 1,
+                 max_resets_per_min: int = 6,
+                 timer: Callable[[], float] = time.monotonic):
+        #: how many times one request may be solo-implicated (health bit,
+        #: or isolated by bisect) and still be replayed. Exceeding it is
+        #: terminal: RequestQuarantined. 0 = quarantine on first trip.
+        self.retry_budget = max(0, retry_budget)
+        #: engine resets allowed per rolling minute; 0 = unlimited.
+        #: Past the limit the engine must NOT reset again (it falls back
+        #: to failing the affected requests — the PR 1 outer ring).
+        self.max_resets_per_min = max(0, max_resets_per_min)
+        self._timer = timer
+        self._lock = threading.Lock()
+        self._reset_times: deque = deque()
+        self.resets: Dict[str, int] = {}
+        self.quarantined: Dict[str, int] = {}
+        self.health_trips = 0
+        self.replayed_tokens = 0
+        self.replayed_requests = 0
+        self.last_reset_wall: Optional[float] = None   # time.time()
+        self.last_reset_cause: Optional[str] = None
+        #: optional listener invoked (cause) AFTER each recorded reset —
+        #: the service layer wires this to the PR 1 circuit breaker so a
+        #: reset storm opens it even while individual requests recover.
+        self.on_reset: Optional[Callable[[str], None]] = None
+
+    # ------------------------------------------------------------- policy
+
+    def allow_reset(self) -> bool:
+        """May the engine reset NOW? False once the rolling-minute budget
+        is spent — the caller must degrade to fail-fast instead (whose
+        errors feed the breaker), not reset in a tight loop."""
+        if self.max_resets_per_min <= 0:
+            return True
+        with self._lock:
+            self._prune_locked()
+            return len(self._reset_times) < self.max_resets_per_min
+
+    def _prune_locked(self) -> None:
+        horizon = self._timer() - 60.0
+        while self._reset_times and self._reset_times[0] <= horizon:
+            self._reset_times.popleft()
+
+    def implicate(self, req) -> bool:
+        """One request was solo-implicated (its health bit tripped, or
+        bisect isolated it). Bumps ``req.suspect_count`` — the field
+        lives on the request object so it survives resets, parking, and
+        re-splices. Returns True when the retry budget is now exhausted
+        → the caller quarantines the request terminally; False → the
+        caller replays it (one more chance — a transient device fault
+        must not kill an innocent request)."""
+        req.suspect_count += 1
+        return req.suspect_count > self.retry_budget
+
+    @staticmethod
+    def split(suspects: List) -> Tuple[List, List]:
+        """Bisection step for a step-wide fault with an unknown culprit:
+        (probe, parked). The probe half replays now; the parked half is
+        held out until the probe either drains clean (innocent — unpark)
+        or poisons another step (recurse into the probe's survivors)."""
+        mid = (len(suspects) + 1) // 2
+        return suspects[:mid], suspects[mid:]
+
+    # ---------------------------------------------------------- recording
+
+    def note_reset(self, cause: str) -> None:
+        with self._lock:
+            self._reset_times.append(self._timer())
+            self.resets[cause] = self.resets.get(cause, 0) + 1
+            self.last_reset_wall = time.time()
+            self.last_reset_cause = cause
+        listener = self.on_reset
+        if listener is not None:
+            try:
+                listener(cause)
+            except Exception:  # pragma: no cover - listener is best-effort
+                pass
+
+    def note_quarantine(self, reason: str) -> None:
+        with self._lock:
+            self.quarantined[reason] = self.quarantined.get(reason, 0) + 1
+
+    def note_health_trips(self, n: int = 1) -> None:
+        with self._lock:
+            self.health_trips += n
+
+    def note_replay(self, tokens: int) -> None:
+        with self._lock:
+            self.replayed_requests += 1
+            self.replayed_tokens += max(0, tokens)
+
+    # ------------------------------------------------------ observability
+
+    def stats(self) -> dict:
+        """Cumulative totals for the metrics delta-mirror
+        (server/metrics.py ``observe_containment``) and /health."""
+        with self._lock:
+            return {
+                "resets": dict(self.resets),
+                "quarantined": dict(self.quarantined),
+                "health_trips": self.health_trips,
+                "replayed_tokens": self.replayed_tokens,
+                "replayed_requests": self.replayed_requests,
+                "retry_budget": self.retry_budget,
+                "max_resets_per_min": self.max_resets_per_min,
+                "last_reset_wall": self.last_reset_wall,
+                "last_reset_cause": self.last_reset_cause,
+            }

@@ -24,8 +24,12 @@ from typing import AsyncIterator, Callable, Dict, List, Optional
 
 import numpy as np
 
+from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
+                          CAUSE_SLOT_HEALTH, PROBATION_CLEAN_CHUNKS,
+                          REASON_HEALTH, REASON_ISOLATED, EngineSupervisor)
 from .fallback import extract_query, rule_command  # rules promoted there
-from .protocol import (EngineResult, EngineUnavailable, GenerationTimeout,
+from .protocol import (HEALTH_NONFINITE, EngineResult, EngineUnavailable,
+                       GenerationTimeout, RequestQuarantined,
                        consume_chunk_row, pack_chunk, scan_chunk_row,
                        unpack_chunk)
 
@@ -119,6 +123,10 @@ class _FakeReq:
     out_queue: asyncio.Queue
     cancel: asyncio.Event
     stream: List[int]             # scripted token ids (ends in EOS)
+    seed: int = 0                 # per-request sampling seed (recorded for
+                                  # replay parity with the real contract)
+    suspect_count: int = 0        # quarantine implications (containment)
+    suspect: bool = False         # in the standing bisection pool
 
 
 @dataclasses.dataclass
@@ -150,6 +158,10 @@ class FakeChunkedEngine:
     def __init__(self, *, batch_size: int = 4, chunk_len: int = 4,
                  chunk_pipe_depth: int = 3, eos_ids=(2,),
                  device_termination: bool = True,
+                 slot_health_check: bool = True,
+                 quarantine_retry_budget: int = 1,
+                 reset_max_per_min: int = 60,
+                 faults=None,
                  stream_fn: Optional[Callable[[str], List[int]]] = None):
         if chunk_pipe_depth < 1:
             raise ValueError("chunk_pipe_depth must be >= 1")
@@ -164,6 +176,19 @@ class FakeChunkedEngine:
         self._inflight: List[tuple] = []   # ("chunk", packed, snapshot)
         self._queue: deque = deque()
         self._task: Optional[asyncio.Task] = None
+        self._monitor: Optional[asyncio.Task] = None
+        #: testing/faults.py injector (decode / scheduler points).
+        self.faults = faults
+        # Fault containment (ISSUE 5) — the numpy twin of the batcher's
+        # inner ring: same supervisor policy object, same health lane in
+        # the packed buffer, same quarantine/bisect/reset-replay flow,
+        # so the recovery matrix is testable in milliseconds.
+        self.slot_health_check = slot_health_check
+        self.supervisor = EngineSupervisor(
+            retry_budget=quarantine_retry_budget,
+            max_resets_per_min=reset_max_per_min)
+        self._parked: List[_FakeSlot] = []
+        self._probation_clean = 0  # clean chunks consumed this probation
         # Mirrors of the batcher's pipeline counters (stats() parity).
         self._wasted_steps = 0
         self._fetches = 0
@@ -199,34 +224,48 @@ class FakeChunkedEngine:
     async def start(self) -> None:
         self._ready = True
         self._task = asyncio.create_task(self._loop())
+        self._monitor = asyncio.create_task(self._supervise())
 
     async def stop(self, drain_secs: float = 0.0) -> None:
         if drain_secs > 0:
             deadline = time.monotonic() + drain_secs
             self._ready = False     # no new admissions
             while time.monotonic() < deadline:
-                if not (self._queue or self._inflight
+                if not (self._queue or self._inflight or self._parked
                         or any(self._slots)):
                     break
                 await asyncio.sleep(0.01)
         self._ready = False
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        for task_attr in ("_task", "_monitor"):
+            task = getattr(self, task_attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except BaseException:
+                    # CancelledError normally; a SchedulerKilled drill
+                    # corpse surfaces here too — both are expected.
+                    pass
+                setattr(self, task_attr, None)
         for slot in self._slots:
             if slot is not None:
                 slot.req.out_queue.put_nowait(
                     ("error", EngineUnavailable("engine stopped")))
         self._slots = [None] * self.batch_size
+        for slot in self._parked:
+            slot.req.out_queue.put_nowait(
+                ("error", EngineUnavailable("engine stopped")))
+        self._parked.clear()
         while self._queue:
             req = self._queue.popleft()
             req.out_queue.put_nowait(
                 ("error", EngineUnavailable("engine stopped")))
         self._inflight.clear()
+
+    def set_reset_listener(self, fn) -> None:
+        """Wire engine resets to the service layer (the PR 1 breaker) —
+        same hook the batcher exposes."""
+        self.supervisor.on_reset = fn
 
     def stats(self) -> dict:
         return {
@@ -241,17 +280,67 @@ class FakeChunkedEngine:
             "chunks_consumed": self._chunks_consumed,
             "chunks_pruned": self._chunks_pruned,
             "fetches": self._fetches,
+            "containment": dict(self.supervisor.stats(),
+                                parked=len(self._parked),
+                                slot_health_check=self.slot_health_check),
         }
 
     # ---------------------------------------------------------- scheduler
 
     async def _loop(self) -> None:
         while True:
-            progressed = self._tick()
+            try:
+                progressed = self._tick()
+            except Exception as e:
+                # A poisoned step, not a dead engine: quarantine/bisect +
+                # reset-and-replay, exactly like the batcher's widened
+                # scheduler except. SchedulerKilled (a BaseException)
+                # deliberately escapes — the task dies and _supervise
+                # restarts it.
+                self._contain_poisoned_step(CAUSE_SCHEDULER_ERROR, error=e)
+                progressed = True
             await asyncio.sleep(0 if progressed else 0.001)
 
+    async def _supervise(self) -> None:
+        """Scheduler-death recovery (the async twin of the batcher's
+        _supervise_scheduler thread): when the loop task dies of an
+        uncatchable fault, reset, replay survivors, restart the loop —
+        queued requests sit untouched in self._queue throughout."""
+        while True:
+            await asyncio.sleep(0.005)
+            task = self._task
+            if task is None or not task.done() or not self._ready:
+                continue
+            task.exception()   # retrieve (the corpse is expected)
+            survivors = [s for s in self._slots if s is not None]
+            self._slots = [None] * self.batch_size
+            self._inflight.clear()
+            if not self.supervisor.allow_reset():
+                self._ready = False
+                err = EngineUnavailable(
+                    "scheduler dead; engine reset budget exhausted")
+                for slot in survivors + self._parked:
+                    slot.req.out_queue.put_nowait(("error", err))
+                self._parked.clear()
+                while self._queue:
+                    self._queue.popleft().out_queue.put_nowait(("error", err))
+                return
+            self.supervisor.note_reset(CAUSE_SCHEDULER_DEATH)
+            for slot in survivors:
+                self._replay_slot(slot)
+            self._task = asyncio.create_task(self._loop())
+
     def _tick(self) -> bool:
+        if self.faults is not None:
+            self.faults.check_scheduler_die()
         self._sweep()
+        if (self._parked and not self._inflight
+                and all(s is None for s in self._slots)):
+            # Probe group drained clean: unpark the held half (they
+            # resume from their generated-so-far prefixes). Long probes
+            # are exonerated earlier, in _consume_oldest.
+            self._unpark_parked()
+            return True
         self._admit_pending()
         self._prune_dead_chunks()
         n_active = sum(s is not None for s in self._slots)
@@ -276,6 +365,11 @@ class FakeChunkedEngine:
                              wasted_inflight=True)
 
     def _admit_pending(self) -> None:
+        if self._parked:
+            # Bisection probation (mirror of the batcher): no new
+            # admissions may join a suspect batch; queued requests wait
+            # and are never dropped.
+            return
         while self._queue and None in self._slots:
             req = self._queue.popleft()
             if req.cancel.is_set():
@@ -302,11 +396,20 @@ class FakeChunkedEngine:
     def _dispatch_chunk(self) -> None:
         """The 'device': advance every live slot's stream cursor by up to
         chunk_len steps, folding EOS/budget termination into the live
-        mask exactly like the jitted scan does, and pack one buffer."""
+        mask exactly like the jitted scan does, and pack one buffer.
+        decode:nan corruption mirrors the jitted detection: the corrupt
+        slot's health bit sets, its row repeats the carry token, and
+        (device termination) it freezes before counting anything."""
         N, C = self.batch_size, self.chunk_len
         toks = np.zeros((N, C), np.int32)
         done = np.zeros((N,), bool)
         lengths = np.zeros((N,), np.int32)
+        health = np.zeros((N,), np.int32)
+        corrupt: set = set()
+        if self.faults is not None:
+            corrupt = set(self.faults.decode_nan_slots(
+                [s.req.prompt if s is not None else None
+                 for s in self._slots]))
         snapshot: List[Optional[_FakeReq]] = [None] * N
         for i, slot in enumerate(self._slots):
             if slot is None:
@@ -314,6 +417,18 @@ class FakeChunkedEngine:
             snapshot[i] = slot.req
             slot.decode_chunks_inflight += 1
             live = slot.dev_active
+            if i in corrupt and self.slot_health_check and (
+                    live or not self.device_termination):
+                health[i] = HEALTH_NONFINITE
+                if self.device_termination:
+                    # Frozen at detection: carry token repeats, nothing
+                    # is counted — live_lengths stay at the pre-chunk
+                    # value, like the jitted scan's in-chunk freeze.
+                    toks[i, :] = slot.last_tok
+                    done[i] = True
+                    slot.dev_active = False
+                    lengths[i] = slot.dev_ngen
+                    continue
             for step in range(C):
                 if self.device_termination:
                     if not live:
@@ -344,7 +459,7 @@ class FakeChunkedEngine:
             1 for s in self._slots if s is not None and s.dev_active
         ) if self.device_termination else sum(
             s is not None for s in self._slots)
-        packed = pack_chunk(toks, done, lengths, n_alive)
+        packed = pack_chunk(toks, done, lengths, n_alive, health=health)
         self._inflight.append(("chunk", packed, snapshot))
         self._chunks_dispatched += 1
 
@@ -368,10 +483,29 @@ class FakeChunkedEngine:
 
     def _consume_oldest(self) -> None:
         _, packed, snapshot = self._inflight.pop(0)
+        if self.faults is not None:
+            # decode:poison_step — step-wide fault from the fetch, routed
+            # into the bisecting containment by the loop's except.
+            self.faults.poison_fetch(
+                [r.prompt if r is not None else None for r in snapshot])
         self._fetches += 1          # the single fetch per chunk
         res = unpack_chunk(packed, self.batch_size, self.chunk_len)
         self._chunks_consumed += 1
         self._last_n_alive = res.n_alive
+        # Slot-health quarantine: nothing from a poisoned chunk is
+        # emitted; replay regenerates the innocents bit-identically.
+        tripped = [
+            i for i in range(self.batch_size)
+            if int(res.health[i]) and snapshot[i] is not None
+            and self._slots[i] is not None
+            and self._slots[i].req is snapshot[i]
+        ]
+        if tripped:
+            self.supervisor.note_health_trips(len(tripped))
+            self._contain_poisoned_step(
+                CAUSE_SLOT_HEALTH,
+                named=[self._slots[i] for i in tripped])
+            return
         for i, slot in enumerate(self._slots):
             if slot is None or slot.req is not snapshot[i]:
                 if snapshot[i] is not None and not self.device_termination:
@@ -393,6 +527,134 @@ class FakeChunkedEngine:
                 slot.req.out_queue.put_nowait(("token", piece))
             if finish is not None:
                 self._finish(i, finish)
+        # Early exoneration (mirror of the batcher): after
+        # PROBATION_CLEAN_CHUNKS clean chunks that actually TESTED a
+        # flagged suspect, suspicion narrows to the parked half, which
+        # replays now instead of stalling admissions until the probe
+        # drains; with nothing parked, the cleared flags close the case.
+        if any(r is not None and r.suspect for r in snapshot):
+            self._probation_clean += 1
+            if self._probation_clean >= PROBATION_CLEAN_CHUNKS:
+                self._probation_clean = 0
+                for s in self._slots:
+                    if s is not None:
+                        s.req.suspect = False
+                if self._parked:
+                    self._unpark_parked()
+        elif self._parked and not any(
+                s is not None and s.req.suspect for s in self._slots
+        ) and not any(
+                r is not None and r.suspect
+                for e in self._inflight if e[0] == "chunk" for r in e[2]):
+            # Every probe suspect completed and none remains in the pipe:
+            # the parked half inherits the suspicion now.
+            self._unpark_parked()
+
+    # ------------------------------------------- containment (ISSUE 5)
+
+    def _fail_all_active(self, error: BaseException) -> None:
+        self._inflight.clear()
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._slots[i] = None
+                slot.req.out_queue.put_nowait(("error", error))
+        for slot in self._parked:
+            slot.req.out_queue.put_nowait(("error", error))
+        self._parked.clear()
+
+    def _contain_poisoned_step(self, cause: str, named=(),
+                               error: Optional[BaseException] = None) -> None:
+        """Quarantine + reset-and-replay — the same flow as
+        BatchedJaxEngine._contain_poisoned_step over numpy state (the
+        'reset' here is dropping the speculative pipeline; per-slot
+        device state is re-derived from host truth by _replay_slot)."""
+        survivors = [s for s in self._slots if s is not None]
+        if not self.supervisor.allow_reset():
+            self._fail_all_active(
+                error if isinstance(error, Exception)
+                else EngineUnavailable("engine reset budget exhausted"))
+            return
+        quarantined: List[_FakeSlot] = []
+        reasons: dict = {}
+        pool = list(survivors)
+        if named:
+            for slot in named:
+                if self.supervisor.implicate(slot.req):
+                    quarantined.append(slot)
+                    reasons[id(slot)] = REASON_HEALTH
+        else:
+            # Mirror of the batcher: narrow to the standing suspect pool
+            # so early exoneration can't widen the next bisection back
+            # out to the whole batch.
+            flagged = [s for s in survivors if s.req.suspect]
+            if flagged:
+                pool = flagged
+            if len(pool) == 1:
+                slot = pool[0]
+                if self.supervisor.implicate(slot.req):
+                    quarantined.append(slot)
+                    reasons[id(slot)] = REASON_ISOLATED
+        self._slots = [None] * self.batch_size
+        self._inflight.clear()
+        self.supervisor.note_reset(cause)
+        qset = {id(s) for s in quarantined}
+        for slot in quarantined:
+            self.supervisor.note_quarantine(reasons[id(slot)])
+            slot.req.out_queue.put_nowait(("error", RequestQuarantined(
+                f"request quarantined after poisoning {cause} "
+                f"{slot.req.suspect_count}x (retry budget "
+                f"{self.supervisor.retry_budget})")))
+        rest = [s for s in survivors
+                if id(s) not in qset and not s.req.cancel.is_set()]
+        if named:
+            probe, parked = rest, []
+        else:
+            # Bisect within the suspect pool only; non-suspects replay
+            # immediately alongside the probe (mirror of the batcher).
+            pool_rest = [s for s in pool
+                         if id(s) not in qset and not s.req.cancel.is_set()]
+            pool_ids = {id(s) for s in pool_rest}
+            innocents = [s for s in rest if id(s) not in pool_ids]
+            if len(pool_rest) <= 1:
+                probe, parked = rest, []
+            else:
+                probe_sus, parked = EngineSupervisor.split(pool_rest)
+                probe = probe_sus + innocents
+            for s in innocents:
+                s.req.suspect = False
+            for s in pool_rest:
+                s.req.suspect = True
+        self._parked.extend(parked)
+        self._probation_clean = 0   # each containment pass restarts probation
+        for slot in probe:
+            self._replay_slot(slot)
+
+    def _unpark_parked(self) -> None:
+        """End bisection probation: replay every parked slot and let
+        admissions resume on the next tick."""
+        parked, self._parked = self._parked, []
+        self._probation_clean = 0
+        for slot in parked:
+            self._replay_slot(slot)
+
+    def _replay_slot(self, slot: _FakeSlot) -> None:
+        """Re-seat one surviving request: the device cursors re-derive
+        from the host-side emitted prefix (the scripted stream is the
+        'model', so replayed tokens are bit-identical by construction —
+        exactly the property the jax engine gets from seeded sampling)."""
+        req = slot.req
+        if req.cancel.is_set():
+            return
+        g = len(slot.emitted)
+        i = self._slots.index(None)
+        slot.dev_idx = g
+        slot.dev_ngen = g
+        slot.last_tok = slot.emitted[-1] if slot.emitted else 0
+        slot.dev_active = (g < req.max_tokens
+                           if self.device_termination else True)
+        slot.decode_chunks_inflight = 0
+        self._slots[i] = slot
+        self.supervisor.note_replay(g)
 
     def _finish(self, slot_idx: int, finish: str,
                 error: Optional[BaseException] = None,
@@ -435,9 +697,13 @@ class FakeChunkedEngine:
         )
 
     async def _stream_events(self, prompt: str, *, max_tokens: int,
-                             timeout: Optional[float]):
+                             timeout: Optional[float],
+                             seed: Optional[int] = None):
         if not self._ready:
             raise EngineUnavailable("FakeChunkedEngine not started")
+        if seed is None:
+            seed = zlib.crc32(
+                prompt.encode("utf-8", "surrogatepass")) & 0x7FFFFFFF
         req = _FakeReq(
             prompt=prompt,
             max_tokens=max(1, max_tokens),
@@ -445,6 +711,7 @@ class FakeChunkedEngine:
             out_queue=asyncio.Queue(),
             cancel=asyncio.Event(),
             stream=list(self.stream_fn(prompt)),
+            seed=int(seed),
         )
         self._queue.append(req)
         try:
@@ -474,9 +741,10 @@ class FakeChunkedEngine:
         max_tokens: int = 128,
         temperature: float = 0.0,
         timeout: Optional[float] = None,
+        seed: Optional[int] = None,
     ) -> EngineResult:
         async for event, payload in self._stream_events(
-                prompt, max_tokens=max_tokens, timeout=timeout):
+                prompt, max_tokens=max_tokens, timeout=timeout, seed=seed):
             if event == "done":
                 return payload
         raise EngineUnavailable("stream ended without a result")
@@ -488,8 +756,9 @@ class FakeChunkedEngine:
         max_tokens: int = 128,
         temperature: float = 0.0,
         timeout: Optional[float] = None,
+        seed: Optional[int] = None,
     ) -> AsyncIterator[str]:
         async for event, payload in self._stream_events(
-                prompt, max_tokens=max_tokens, timeout=timeout):
+                prompt, max_tokens=max_tokens, timeout=timeout, seed=seed):
             if event == "token":
                 yield payload

@@ -1016,7 +1016,8 @@ class JaxEngine:
 
     def _generate_blocking(self, prompt: str, max_tokens: int,
                            temperature: float, deadline: Optional[float],
-                           cancel: Optional["threading.Event"] = None):
+                           cancel: Optional["threading.Event"] = None,
+                           seed: Optional[int] = None):
         """Runs on a worker thread. Yields (event, payload) tuples:
         ("token", text_piece) ... ("done", EngineResult)."""
         cfg = self.model_cfg
@@ -1031,7 +1032,17 @@ class JaxEngine:
             self.tokenizer.encode(prompt), max_tokens
         )
 
-        key = jax.random.PRNGKey(self.seed + n_prompt)
+        # Per-request sampling seed (ISSUE 5 satellite): an explicit seed
+        # pins the whole RNG stream, making this engine's transcripts
+        # deterministic per seed; the legacy derivation (engine seed +
+        # prompt length) stays the default so existing per-config
+        # transcripts don't shift. NOTE the key schedule here is split-
+        # chained through the compiled chunk programs — NOT the batched
+        # engine's fold_in(PRNGKey(seed), g) — so the same seed yields a
+        # different (but equally pinned) transcript than BatchedJaxEngine;
+        # offline reproduction must use the engine class that recorded it.
+        key = jax.random.PRNGKey(self.seed + n_prompt if seed is None
+                                 else int(seed) & 0x7FFFFFFF)
         key, chunk_key = jax.random.split(key)
         temp_d = jnp.asarray(temperature, jnp.float32)
 
@@ -1175,10 +1186,12 @@ class JaxEngine:
         max_tokens: int = 128,
         temperature: float = 0.0,
         timeout: Optional[float] = None,
+        seed: Optional[int] = None,
     ) -> EngineResult:
         result: Optional[EngineResult] = None
         async for event, payload in self._stream_events(
-            prompt, max_tokens=max_tokens, temperature=temperature, timeout=timeout
+            prompt, max_tokens=max_tokens, temperature=temperature,
+            timeout=timeout, seed=seed
         ):
             if event == "done":
                 result = payload
@@ -1192,20 +1205,28 @@ class JaxEngine:
         max_tokens: int = 128,
         temperature: float = 0.0,
         timeout: Optional[float] = None,
+        seed: Optional[int] = None,
     ) -> AsyncIterator[str]:
         async for event, payload in self._stream_events(
-            prompt, max_tokens=max_tokens, temperature=temperature, timeout=timeout
+            prompt, max_tokens=max_tokens, temperature=temperature,
+            timeout=timeout, seed=seed
         ):
             if event == "token":
                 yield payload
 
     async def _stream_events(self, prompt: str, *, max_tokens: int,
-                             temperature: float, timeout: Optional[float]):
+                             temperature: float, timeout: Optional[float],
+                             seed: Optional[int] = None):
         if not self._ready:
             raise EngineUnavailable("JaxEngine not started")
         from ..obs.trace import trace_event
 
-        trace_event("engine: submitted to single-sequence engine")
+        if seed is not None:
+            trace_event(
+                f"engine: submitted to single-sequence engine "
+                f"(sampling seed {int(seed)})")
+        else:
+            trace_event("engine: submitted to single-sequence engine")
         t_queue0 = time.monotonic()
         deadline = (t_queue0 + timeout) if timeout else None
         # Count this request as in flight from acceptance, INCLUDING the
@@ -1230,7 +1251,8 @@ class JaxEngine:
                 loop = asyncio.get_running_loop()
                 cancel = threading.Event()
                 gen = self._generate_blocking(prompt, max_tokens,
-                                              temperature, deadline, cancel)
+                                              temperature, deadline, cancel,
+                                              seed=seed)
                 try:
                     while True:
                         fut = loop.run_in_executor(None, next, gen, None)

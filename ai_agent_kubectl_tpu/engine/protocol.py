@@ -34,14 +34,29 @@ class GenerationTimeout(TimeoutError):
     (reference app.py:189-191)."""
 
 
+class RequestQuarantined(RuntimeError):
+    """Terminal per-request failure from the fault-containment subsystem
+    → HTTP 410 (Gone).
+
+    The culprit-isolation pass (engine/containment.py) decided this
+    request keeps poisoning decode steps (NaN/Inf logits, out-of-range
+    token ids, or step-wide faults that bisect down to it) and its
+    QUARANTINE_RETRY_BUDGET is spent. Deliberately NOT an
+    ``EngineUnavailable`` subclass: the engine is healthy — retrying the
+    same request elsewhere would just poison another batch, so this must
+    not trip the circuit breaker, route to the degraded fallback, or
+    invite a load-balancer retry the way a 503 does."""
+
+
 # ---------------------------------------------------------------------------
-# Packed chunk-result contract (decode pipeline seam)
+# Packed chunk-result contract (decode pipeline seam) — v2
 #
-# A decode chunk returns ONE flat int32 buffer so tokens, termination, and
-# occupancy cross the host↔device link in a single fetch:
+# A decode chunk returns ONE flat int32 buffer so tokens, termination,
+# occupancy, AND per-slot health cross the host↔device link in a single
+# fetch:
 #
 #     [ tokens (n_slots × chunk_len) | done_mask (n_slots)
-#       | live_lengths (n_slots) | n_alive (1) ]
+#       | live_lengths (n_slots) | health (n_slots) | n_alive (1) ]
 #
 # - ``tokens[i]``: the chunk's sampled token ids for slot i (entries past
 #   the slot's termination point repeat its last counted token — garbage
@@ -51,6 +66,13 @@ class GenerationTimeout(TimeoutError):
 # - ``live_lengths[i]``: slot i's CUMULATIVE completion-token count after
 #   this chunk (device-resident occupancy fact; the consumer derives this
 #   chunk's valid tokens as ``live_lengths[i] - already_emitted``).
+# - ``health[i]``: bitmask of corruption the device detected in slot i
+#   THIS chunk (v2 addition, SLOT_HEALTH_CHECK): HEALTH_NONFINITE = the
+#   slot's logits contained NaN/Inf, HEALTH_TOKEN_RANGE = the sampled
+#   token id fell outside [0, vocab). A tripped slot is frozen inside the
+#   chunk (no further sampling/KV writes) and its garbage is never
+#   counted in ``live_lengths`` — the scheduler's quarantine pass
+#   (engine/containment.py) takes it from there. 0 = healthy.
 # - ``n_alive``: slots still decoding after the chunk — the scheduler's
 #   early-retirement signal.
 #
@@ -59,12 +81,31 @@ class GenerationTimeout(TimeoutError):
 # on the fake engine exercise the real contract.
 # ---------------------------------------------------------------------------
 
-PACKED_CHUNK_VERSION = 1
+PACKED_CHUNK_VERSION = 2
+
+#: health-word bits (per slot, OR-able). Device-side detection writes
+#: them inside the jitted chunk scan; the fake engine's numpy twin writes
+#: the same bits, so the quarantine pass is engine-agnostic.
+HEALTH_OK = 0
+HEALTH_NONFINITE = 1      # NaN/Inf in the slot's step logits
+HEALTH_TOKEN_RANGE = 2    # sampled token id outside [0, vocab_size)
+
+_HEALTH_NAMES = ((HEALTH_NONFINITE, "nonfinite_logits"),
+                 (HEALTH_TOKEN_RANGE, "token_out_of_range"))
+
+
+def describe_health(word: int) -> str:
+    """Human/metric label for a health bitmask (``"nonfinite_logits"``,
+    ``"nonfinite_logits|token_out_of_range"``, ...)."""
+    parts = [name for bit, name in _HEALTH_NAMES if word & bit]
+    if int(word) and not parts:  # unknown future bit
+        parts = [f"bit{int(word)}"]
+    return "|".join(parts) or "ok"
 
 
 def packed_chunk_size(n_slots: int, chunk_len: int) -> int:
     """Flat length of one packed chunk buffer."""
-    return n_slots * chunk_len + 2 * n_slots + 1
+    return n_slots * chunk_len + 3 * n_slots + 1
 
 
 @dataclass
@@ -74,19 +115,25 @@ class ChunkResult:
     tokens: np.ndarray      # [n_slots, chunk_len] int32
     done: np.ndarray        # [n_slots] bool
     lengths: np.ndarray     # [n_slots] int32 cumulative completion tokens
+    health: np.ndarray      # [n_slots] int32 health bitmask (0 = healthy)
     n_alive: int
 
 
-def pack_chunk(tokens, done, lengths, n_alive, *, xp=np):
+def pack_chunk(tokens, done, lengths, n_alive, *, health=None, xp=np):
     """Flatten one chunk's results into the single-fetch buffer.
 
     ``xp`` is the array namespace — ``numpy`` for the fake engine,
     ``jax.numpy`` inside the jitted chunk program (the concatenate then
-    happens on device and the scheduler fetches one array)."""
+    happens on device and the scheduler fetches one array). ``health``
+    defaults to all-healthy for callers predating the v2 lane."""
+    done = done.astype(xp.int32)
+    if health is None:
+        health = xp.zeros_like(done)
     return xp.concatenate([
         xp.reshape(tokens, (-1,)).astype(xp.int32),
-        done.astype(xp.int32),
+        done,
         lengths.astype(xp.int32),
+        health.astype(xp.int32),
         xp.reshape(xp.asarray(n_alive, dtype=xp.int32), (1,)),
     ])
 
@@ -104,6 +151,7 @@ def unpack_chunk(buf, n_slots: int, chunk_len: int) -> ChunkResult:
         tokens=buf[:nt].reshape(n_slots, chunk_len),
         done=buf[nt:nt + n_slots].astype(bool),
         lengths=buf[nt + n_slots:nt + 2 * n_slots].astype(np.int32),
+        health=buf[nt + 2 * n_slots:nt + 3 * n_slots].astype(np.int32),
         n_alive=int(buf[-1]),
     )
 

@@ -110,6 +110,29 @@ def sample_token_traced(
         return jax.lax.cond(temperature > 0.0, _sampled, _greedy, None)
 
 
+def _sample_rows(logits, temperatures, active, draw):
+    """Shared per-row decode-step scaffold: greedy argmax fallback,
+    per-slot ``wants_sample`` mask (temperature > 0, intersected with the
+    device-resident ``active`` mask so finished slots stop paying for
+    sampling), and the ``lax.cond`` that skips the categorical branch
+    entirely for all-greedy batches. ``draw`` maps temperature-scaled
+    logits [batch, vocab] → sampled ids [batch]; it is the ONLY thing
+    that differs between the shared-key and per-request-seeded paths, so
+    the distribution-parity-critical body lives here exactly once."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    wants_sample = temperatures > 0.0
+    if active is not None:
+        wants_sample = jnp.logical_and(wants_sample, active)
+
+    def _with_sampling(_):
+        t = jnp.maximum(temperatures, 1e-6)[:, None]
+        return jnp.where(wants_sample, draw(logits / t), greedy)
+
+    return jax.lax.cond(
+        jnp.any(wants_sample), _with_sampling, lambda _: greedy, None,
+    )
+
+
 def sample_tokens_batched(
     logits: jnp.ndarray,            # [batch, vocab] f32
     key: jax.Array,
@@ -118,35 +141,76 @@ def sample_tokens_batched(
     top_p: float = 1.0,
     active: jnp.ndarray | None = None,  # [batch] bool — rows still decoding
 ) -> jnp.ndarray:
-    """Per-row sampling for the continuous-batching decode step: each slot
-    carries its own temperature; top-k/top-p are static service config
-    applied identically to every sampled row — the same filtering
-    ``sample_token_traced`` runs, so the batched and single-sequence
-    engines sample from the same distribution at the same settings. The
-    categorical branch (gumbel noise + filtering — over batch×k when a
-    top-k is set, batch×vocab otherwise) only executes when some slot
-    actually samples; all-greedy batches take the argmax-only path.
+    """Shared-key per-row sampling: one PRNG key per step, split across
+    the rows by the categorical. Since the seeded-sampling switch (ISSUE
+    5) the serving decode step runs ``sample_tokens_seeded`` instead —
+    this variant is kept as the reference implementation for the
+    distribution-parity tests (tests/test_sampling.py) and the decode
+    profiling tool (tools/profile_decode.py), which has no per-request
+    seeds to thread. Same ``_sample_rows`` scaffold and
+    ``_sample_filtered`` body, so the two variants cannot diverge in
+    anything but key derivation.
 
-    ``active`` is the device-resident done mask's view of the batch
-    (engine/batcher.py): finished slots stop paying for sampling — a
-    batch whose only non-greedy rows have all terminated mid-chunk takes
-    the argmax-only branch, and dead rows never influence the taken
-    path. The caller still selects its own carry value for dead rows."""
+    Each slot carries its own temperature; top-k/top-p are static service
+    config applied identically to every sampled row — the same filtering
+    ``sample_token_traced`` runs, so batched and single-sequence paths
+    sample from the same distribution at the same settings. ``active``
+    is the device-resident done mask's view of the batch: finished slots
+    stop paying for sampling, and all-greedy batches take the argmax-only
+    branch. The caller still selects its own carry value for dead rows."""
     with jax.named_scope("sampling"):
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        wants_sample = temperatures > 0.0
-        if active is not None:
-            wants_sample = jnp.logical_and(wants_sample, active)
-
-        def _with_sampling(_):
-            t = jnp.maximum(temperatures, 1e-6)[:, None]
-            sampled = _sample_filtered(logits / t, key, top_k, top_p)
-            return jnp.where(wants_sample, sampled, greedy)
-
-        return jax.lax.cond(
-            jnp.any(wants_sample), _with_sampling, lambda _: greedy,
-            None,
+        return _sample_rows(
+            logits, temperatures, active,
+            lambda scaled: _sample_filtered(scaled, key, top_k, top_p),
         )
+
+
+def slot_keys(seeds: jnp.ndarray, ngen: jnp.ndarray) -> jnp.ndarray:
+    """[batch] per-request seeds × [batch] per-slot generation indices →
+    [batch] PRNG keys: ``fold_in(PRNGKey(seed_i), ngen_i)``.
+
+    This is THE replay-parity primitive (engine/containment.py): token
+    ``g`` of request ``r`` is sampled under a key that depends only on
+    ``(seed_r, g)`` — never on batch composition, chunk boundaries, or
+    how many times the engine reset underneath the request — so a
+    reset-and-replay that re-splices the request at generation index
+    ``g`` continues the exact RNG stream a fault-free run would have
+    used."""
+    def one(seed, n):
+        return jax.random.fold_in(jax.random.PRNGKey(seed), n)
+
+    return jax.vmap(one)(seeds, ngen)
+
+
+def sample_tokens_seeded(
+    logits: jnp.ndarray,            # [batch, vocab] f32
+    seeds: jnp.ndarray,             # [batch] int32 per-request seeds
+    ngen: jnp.ndarray,              # [batch] int32 per-slot generation index
+    temperatures: jnp.ndarray,      # [batch] traced — per-slot temperature
+    top_k: int = 0,
+    top_p: float = 1.0,
+    active: jnp.ndarray | None = None,  # [batch] bool — rows still decoding
+) -> jnp.ndarray:
+    """Per-row sampling under per-request RNG streams (``slot_keys``):
+    the continuous-batching decode step and the admission first-token
+    sample both run this, so a request's sampled tokens are a pure
+    function of (its seed, its generation index, its logits) — the
+    property the fault-containment replay relies on for bit-identical
+    recovered transcripts, and what makes any transcript reproducible
+    offline from the seed exposed in /debug/requests/{id}.
+
+    Same top-k/top-p filtering as ``sample_tokens_batched`` (the shared
+    ``_sample_rows`` scaffold, each row through ``_sample_filtered``);
+    only the key derivation differs — per-row independent streams
+    instead of one shared key per step."""
+
+    def _draw(scaled):
+        return jax.vmap(
+            lambda row, k: _sample_filtered(row, k, top_k, top_p)
+        )(scaled, slot_keys(seeds, ngen))
+
+    with jax.named_scope("sampling"):
+        return _sample_rows(logits, temperatures, active, _draw)
 
 
 def eos_mask(tokens: jnp.ndarray, eos_ids) -> jnp.ndarray:

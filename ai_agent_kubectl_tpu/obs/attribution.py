@@ -420,7 +420,7 @@ def run_attribution(*, model: str = "gemma-7b-it", quant: str = "int8",
 
     batched_chunk = make_termination_chunk_fn(
         forward_step, chunk_len, tuple(sorted(set(cfg.eos_ids))),
-        top_k, top_p)
+        top_k, top_p, vocab_size=cfg.vocab_size)
 
     fn = jax.jit(batched_chunk, donate_argnums=(1, 2, 3, 7, 8))
 
@@ -435,7 +435,8 @@ def run_attribution(*, model: str = "gemma-7b-it", quant: str = "int8",
     tok = jnp.zeros((N, 1), jnp.int32)
     pos = jnp.full((N, 1), pos0, jnp.int32)
     cache = KVCache.zeros(cfg, N, S_alloc, dtype=jdtype, kv_quant=kv_quant)
-    key = jax.random.PRNGKey(0)
+    seeds = jnp.zeros((N,), jnp.int32)
+    no_corrupt = jnp.zeros((N,), jnp.bool_)
     temps = jnp.zeros((N,), jnp.float32)
     # All lanes force-live with an unreachable budget, and fresh all-live
     # carry state per dispatch: a sampled EOS from random-init weights
@@ -454,9 +455,9 @@ def run_attribution(*, model: str = "gemma-7b-it", quant: str = "int8",
         np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
 
     active, ngen = all_live()
-    packed, tok, pos, cache, key, _, _ = fn(
-        params, tok, pos, cache, key, temps, force, active,
-        ngen, budget)                                     # compile + warm
+    packed, tok, pos, cache, _, _ = fn(
+        params, tok, pos, cache, seeds, temps, force, active,
+        ngen, budget, no_corrupt)                         # compile + warm
     sync(packed)
 
     trace_dir = tempfile.mkdtemp(prefix="attr_step_")
@@ -465,9 +466,9 @@ def run_attribution(*, model: str = "gemma-7b-it", quant: str = "int8",
         with jax.profiler.trace(trace_dir):
             for _ in range(reps):
                 active, ngen = all_live()
-                packed, tok, pos, cache, key, _, _ = fn(
-                    params, tok, pos, cache, key, temps, force, active,
-                    ngen, budget)
+                packed, tok, pos, cache, _, _ = fn(
+                    params, tok, pos, cache, seeds, temps, force, active,
+                    ngen, budget, no_corrupt)
             sync(packed)
         wall_s = time.perf_counter() - t0
         steps = reps * chunk_len

@@ -36,7 +36,8 @@ from pydantic import ValidationError
 from ..config import ServiceConfig
 from ..engine.fallback import FallbackEngine
 from ..engine.protocol import (Engine, EngineOverloaded, EngineResult,
-                               EngineUnavailable, GenerationTimeout)
+                               EngineUnavailable, GenerationTimeout,
+                               RequestQuarantined)
 from ..engine.prompts import render_prompt
 from ..obs import (PHASES, FlightRecorder, Trace, current_trace,
                    new_request_id, sanitize_request_id, use_trace)
@@ -135,6 +136,23 @@ class Service:
         # engine_tokens_per_sec gauge at scrape time (see WindowedRate).
         self.recorder = FlightRecorder(cfg.flight_recorder_size)
         self.token_rate = WindowedRate()
+        # Inner ring → outer ring: every engine reset-and-replay also
+        # counts as a breaker failure, so a flapping engine (reset storm)
+        # opens the breaker even while individual requests keep
+        # recovering. The supervisor calls from the scheduler thread;
+        # marshal onto the event loop when one has been seen (breaker
+        # transitions are event-loop-only by design).
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        hook = getattr(engine, "set_reset_listener", None)
+        if callable(hook):
+            hook(self._on_engine_reset)
+
+    def _on_engine_reset(self, cause: str) -> None:
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+            loop.call_soon_threadsafe(self.breaker.record_failure)
+        else:  # pragma: no cover - pre-traffic reset
+            self.breaker.record_failure()
 
     def retry_after_hint(self) -> float:
         """Retry-After for HTTP-layer sheds: the engine's drain-rate
@@ -171,9 +189,17 @@ class Service:
         # ready AFTER the call began — still counts as the engine failure
         # it is.
         was_ready = bool(getattr(self.engine, "ready", True))
+        if self._loop is None:
+            self._loop = asyncio.get_running_loop()
         decided = False
         try:
             result = await coro_fn()
+        except RequestQuarantined:
+            # Terminal per-REQUEST failure: the engine contained it and
+            # is healthy — counting it as an engine failure would let one
+            # hostile request pattern open the breaker for everyone. The
+            # finally below releases the probe slot.
+            raise
         except EngineOverloaded:
             # Counted here — once per actual engine shed — rather than in
             # the handlers, where every coalesced single-flight waiter
@@ -468,6 +494,13 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
     except EngineOverloaded as e:
         return _json_error(503, f"Server overloaded: {e}",
                            headers=_retry_after_header(e.retry_after))
+    except RequestQuarantined as e:
+        # 410 Gone: the request itself poisoned decode steps past its
+        # quarantine retry budget. Terminal by design — a retry would
+        # just poison another batch, so no Retry-After and no fallback.
+        logger.error("Request quarantined for query '%s': %s",
+                     sanitized_query, e)
+        return _json_error(410, f"Request quarantined: {e}")
     except EngineUnavailable as e:
         return _json_error(503, f"Engine not available: {e}")
     except (GenerationTimeout, asyncio.TimeoutError):
@@ -662,6 +695,10 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
         # client should back off, not be absorbed by the rule table.
         # (queue_rejections is counted inside run_engine, once per shed.)
         await write_safe(sse(f"engine overloaded: {e}", event="error"))
+    except RequestQuarantined as e:
+        # Terminal: this request poisons decode steps; never degraded,
+        # never retried (410 analog for an already-committed stream).
+        await write_safe(sse(f"request quarantined: {e}", event="error"))
     except (EngineUnavailable, GenerationTimeout, asyncio.TimeoutError) as e:
         if svc.fallback is not None:
             try:
@@ -757,6 +794,18 @@ async def handle_health(request: web.Request) -> web.Response:
     svc: Service = request.app["service"]
     ready = bool(getattr(svc.engine, "ready", False))
     breaker = svc.breaker.state
+    # Inner-ring containment state: when the engine last reset its
+    # decode state and why — read off the supervisor directly (NOT via
+    # engine.stats(), which drains the fetch-latency samples owed to the
+    # /metrics histogram; LBs probe /health several times a second).
+    last_reset = last_cause = None
+    sup = (getattr(svc.engine, "supervisor", None)
+           or getattr(getattr(svc.engine, "inner", None), "supervisor",
+                      None))
+    if sup is not None and sup.last_reset_wall:
+        last_reset = (time.strftime("%Y-%m-%dT%H:%M:%S",
+                                    time.gmtime(sup.last_reset_wall)) + "Z")
+        last_cause = sup.last_reset_cause
     body = HealthResponse(
         status="healthy" if ready and breaker == "closed" else "degraded",
         engine=getattr(svc.engine, "name", "unknown"),
@@ -765,6 +814,8 @@ async def handle_health(request: web.Request) -> web.Response:
         devices=_device_count(request.app),
         breaker=breaker,
         degraded_fallback=svc.fallback is not None,
+        last_reset=last_reset,
+        last_reset_cause=last_cause,
     )
     # The HTTP status tracks engine readiness alone: an open breaker with
     # the engine process alive still serves (fallback and/or cache), and
@@ -909,6 +960,9 @@ async def handle_metrics(request: web.Request) -> web.Response:
         # Decode-pipeline metrics (pipe occupancy, wasted decode steps,
         # chunk dispatch/consume/prune counts, fetch-latency histogram).
         svc.metrics.observe_pipeline(stats)
+        # Containment counters (resets, quarantines, health trips,
+        # replayed tokens) — same delta-mirror pattern.
+        svc.metrics.observe_containment(stats)
     # Windowed throughput gauge: the batcher's own scheduler-side window
     # when it reports one (counts every finish, including streams), else
     # the service-side window fed by the response handlers.

@@ -82,10 +82,13 @@ def build_engine(cfg: ServiceConfig) -> Engine:
 
     injector = FaultInjector.from_spec(cfg.fault_points)
     if injector is not None:
-        # admit/chunk are only checked by the continuous-batching engine;
-        # an armed point the selected engine can never fire would make the
-        # drill silently inert — refuse to boot instead.
-        needs_batcher = [p for p in ("admit", "chunk") if injector.has(p)]
+        # admit/chunk/decode/scheduler are only checked by the
+        # continuous-batching engine; an armed point the selected engine
+        # can never fire would make the drill silently inert — refuse to
+        # boot instead. (FakeChunkedEngine also speaks decode/scheduler,
+        # but it is a test harness, not a factory-selectable ENGINE.)
+        needs_batcher = [p for p in ("admit", "chunk", "decode", "scheduler")
+                         if injector.has(p)]
         batched = cfg.engine in ("jax", "jax-batched") and (
             cfg.engine == "jax-batched" or cfg.decode_batch_size > 1)
         if needs_batcher and not batched:

@@ -220,6 +220,38 @@ class Metrics:
             registry=r,
         )
 
+        # Blast-radius containment (ISSUE 5, the inner ring): engine
+        # resets by cause, terminal quarantines by reason, device health
+        # trips, and tokens regenerated by reset-and-replay. Cumulative
+        # totals live on the engine supervisor; scrapes delta-mirror them
+        # like the pipeline counters (observe_containment).
+        self.engine_resets = Counter(
+            "engine_resets_total",
+            "Decode-state reset-and-replay cycles",
+            ["cause"],  # slot_health | scheduler_error | scheduler_death
+            registry=r,
+        )
+        self.quarantined_requests = Counter(
+            "quarantined_requests_total",
+            "Requests terminally quarantined by culprit isolation",
+            ["reason"],  # slot_health | step_poison
+            registry=r,
+        )
+        self.replayed_tokens = Counter(
+            "replayed_tokens_total",
+            "Already-generated tokens re-spliced and replayed across "
+            "engine resets (innocent-victim recovery)",
+            registry=r,
+        )
+        self.slot_health_trips = Counter(
+            "slot_health_trips_total",
+            "Per-slot device health-word trips (NaN/Inf logits, "
+            "out-of-range token ids) caught in the decode chunk",
+            registry=r,
+        )
+        self._containment_seen = {"resets": {}, "quarantined": {},
+                                  "health_trips": 0, "replayed_tokens": 0}
+
         # Request-lifecycle phase attribution (obs/trace.py): where a
         # request's wall time went. The ``phase`` label is drawn from the
         # fixed obs.PHASES allowlist — cardinality is bounded by
@@ -255,6 +287,32 @@ class Metrics:
                 self._pipe_seen[event] = total
         for s in stats.get("chunk_fetch_secs", ()):
             self.chunk_fetch.observe(s)
+
+    def observe_containment(self, stats: dict) -> None:
+        """Delta-mirror the engine supervisor's containment totals
+        (stats()["containment"]) into the labelled Prometheus counters —
+        same scrape-time pattern as ``observe_pipeline``."""
+        c = stats.get("containment")
+        if not c:
+            return
+        seen = self._containment_seen
+        for cause, total in c.get("resets", {}).items():
+            prev = seen["resets"].get(cause, 0)
+            if total > prev:
+                self.engine_resets.labels(cause=cause).inc(total - prev)
+                seen["resets"][cause] = total
+        for reason, total in c.get("quarantined", {}).items():
+            prev = seen["quarantined"].get(reason, 0)
+            if total > prev:
+                self.quarantined_requests.labels(reason=reason).inc(
+                    total - prev)
+                seen["quarantined"][reason] = total
+        for key, counter in (("health_trips", self.slot_health_trips),
+                             ("replayed_tokens", self.replayed_tokens)):
+            total = c.get(key, 0)
+            if total > seen[key]:
+                counter.inc(total - seen[key])
+                seen[key] = total
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

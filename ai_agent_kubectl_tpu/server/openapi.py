@@ -94,6 +94,11 @@ def build_openapi() -> Dict:
                              "generation-phase metadata"),
                 "400": _err("Invalid input query (pydantic validation)"),
                 "401": auth_err,
+                "410": _err("Request quarantined: it repeatedly poisoned "
+                            "decode steps (NaN/Inf corruption or "
+                            "step-wide faults isolated to it) past "
+                            "QUARANTINE_RETRY_BUDGET. Terminal — do not "
+                            "retry"),
                 "422": _err("Generated command failed safety validation"),
                 "429": rate_err,
                 "500": _err("Internal error"),

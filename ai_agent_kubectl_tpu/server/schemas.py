@@ -85,3 +85,8 @@ class HealthResponse(BaseModel):
     # instead of 503s.
     breaker: str = "closed"
     degraded_fallback: bool = False
+    # Inner-ring containment (engine/containment.py): when the engine
+    # last reset-and-replayed its decode state (ISO 8601) and why
+    # (slot_health | scheduler_error | scheduler_death). None = never.
+    last_reset: Optional[str] = None
+    last_reset_cause: Optional[str] = None

@@ -7,6 +7,16 @@ fault points that the engine layer checks at its seams:
 - ``chunk``    — batched decode dispatch (BatchedJaxEngine._dispatch_chunk;
   a ``hang`` here blocks the scheduler thread exactly like a hung device
   dispatch, which is what trips the engine watchdog)
+- ``decode``   — device-shaped decode faults for the containment subsystem
+  (ISSUE 5): ``decode:nan:<p>`` corrupts ONE slot's logits to NaN inside
+  the decode chunk with probability ``p`` per dispatch (the device-side
+  health word must catch it); ``decode:poison_step[:p]`` raises from the
+  chunk FETCH — a step-wide poison naming no slot, which is what the
+  bisecting culprit-isolation pass exists for
+- ``scheduler`` — ``scheduler:die`` kills the scheduler loop
+  thread/task (raises a BaseException the poisoned-step containment
+  deliberately cannot catch); fires ONCE then disarms, so the drill
+  tests the supervisor restart, not an unrecoverable crash loop
 - ``generate`` — the whole engine call (applied by ``ChaosEngine``, the
   protocol wrapper the factory installs when FAULT_POINTS names it)
 
@@ -17,10 +27,19 @@ Modes (the third ``:``-field is mode-specific):
 - ``delay:seconds`` — sleep that long, then proceed
 - ``hang[:max_secs]`` — block until ``release()`` is called or ``max_secs``
   elapses (default 60); models a dispatch that never completes
+- ``nan[:rate]`` — (``decode`` only) corrupt one slot's logits
+- ``poison_step[:rate]`` — (``decode`` only) raise from the chunk fetch
+- ``die`` — (``scheduler`` only) kill the scheduler loop, one-shot
+
+Targeting: by default ``decode`` faults pick the first live slot. Tests
+that need the fault to FOLLOW one request across resets/replays set
+``injector.target_substr`` — slots whose prompt contains the substring
+are the (only) candidates, wherever quarantine/replay re-seats them.
 
 The same injector object drives deterministic chaos tests programmatically
-(``set``/``release``/``clear``/``fired``) — tests/test_chaos.py is the
-consumer that proves the watchdog, load-shedding, and breaker paths.
+(``set``/``release``/``clear``/``fired``) — tests/test_chaos.py and
+tests/test_containment.py are the consumers that prove the watchdog,
+load-shedding, breaker, and quarantine/reset-replay paths.
 """
 
 from __future__ import annotations
@@ -30,17 +49,32 @@ import dataclasses
 import random
 import threading
 import time
-from typing import AsyncIterator, Dict, Optional
+from typing import AsyncIterator, Dict, List, Optional, Sequence
 
 from ..engine.protocol import EngineResult, EngineUnavailable
 
 _DEFAULT_HANG_SECS = 60.0
 
-_MODES = ("error", "delay", "hang")
+_MODES = ("error", "delay", "hang", "nan", "poison_step", "die")
 
 #: the closed set of check sites; a typo'd point in FAULT_POINTS must be
 #: a startup error, not a silently inert game-day drill.
-KNOWN_POINTS = ("admit", "chunk", "generate")
+KNOWN_POINTS = ("admit", "chunk", "decode", "scheduler", "generate")
+
+#: (point, mode) pairs that only make sense together — a drill spec
+#: arming e.g. ``admit:nan`` is a typo, not chaos.
+_POINT_ONLY_MODES = {"nan": ("decode",), "poison_step": ("decode",),
+                     "die": ("scheduler",)}
+_RESTRICTED_POINTS = {"decode": ("nan", "poison_step"),
+                      "scheduler": ("die",)}
+
+
+class SchedulerKilled(BaseException):
+    """``scheduler:die`` — deliberately NOT an ``Exception`` so the
+    scheduler's widened poisoned-step ``except`` cannot absorb it: the
+    loop thread/task genuinely dies, and what's under test is the
+    engine supervisor detecting the corpse and restarting the loop with
+    zero dropped queued requests."""
 
 
 class InjectedFault(EngineUnavailable):
@@ -65,6 +99,11 @@ class FaultInjector:
         self._faults: Dict[str, _Fault] = {}
         self._fired: Dict[str, int] = {}
         self._rng = random.Random(seed)
+        #: decode-fault targeting (test hook): when set, only slots whose
+        #: prompt contains this substring are candidates — the fault
+        #: follows ONE request across quarantine replays and engine
+        #: resets instead of whichever request happens to sit in a slot.
+        self.target_substr: Optional[str] = None
 
     # ------------------------------------------------------------- config
 
@@ -109,6 +148,18 @@ class FaultInjector:
             raise ValueError(
                 f"fault mode must be one of {_MODES}, got {mode!r}"
             )
+        only = _POINT_ONLY_MODES.get(mode)
+        if only is not None and point not in only:
+            raise ValueError(
+                f"fault mode {mode!r} only applies to point(s) {only}, "
+                f"got {point!r}"
+            )
+        restricted = _RESTRICTED_POINTS.get(point)
+        if restricted is not None and mode not in restricted:
+            raise ValueError(
+                f"fault point {point!r} only supports mode(s) {restricted}, "
+                f"got {mode!r}"
+            )
         if mode == "delay" and arg is None:
             raise ValueError("delay mode needs seconds (point:delay:secs)")
         if arg is not None and arg < 0:
@@ -117,13 +168,16 @@ class FaultInjector:
             # startup error, same as a typo'd point or mode.
             raise ValueError(f"fault arg must be >= 0, got {arg}")
         rate = 1.0
-        if mode == "error":
+        if mode in ("error", "nan", "poison_step"):
             rate = 1.0 if arg is None else float(arg)
             if not 0.0 <= rate <= 1.0:
-                raise ValueError(f"error rate must be in [0,1], got {rate}")
+                raise ValueError(
+                    f"{mode} rate must be in [0,1], got {rate}")
             arg = 0.0
         if mode == "hang":
             arg = _DEFAULT_HANG_SECS if arg is None else float(arg)
+        if arg is None:   # die (one-shot) carries no argument
+            arg = 0.0
         old = self._faults.get(point)
         if old is not None:
             # A thread may be blocked on the replaced fault's hang event;
@@ -188,9 +242,66 @@ class FaultInjector:
                and time.monotonic() < deadline):
             await asyncio.sleep(0.01)
 
+    # --------------------------------- device-shaped points (containment)
+
+    def _targets(self, prompts: Sequence[Optional[str]]) -> List[int]:
+        """Candidate slot indices for a decode fault: slots whose prompt
+        matches ``target_substr`` when set, else the first live slot —
+        chaos needs *a* victim, tests need *the* victim."""
+        live = [i for i, p in enumerate(prompts) if p is not None]
+        if self.target_substr is not None:
+            return [i for i in live
+                    if self.target_substr in (prompts[i] or "")]
+        return live[:1]
+
+    def decode_nan_slots(
+            self, prompts: Sequence[Optional[str]]) -> List[int]:
+        """Slots whose logits this chunk dispatch should corrupt to NaN
+        (``decode:nan:<p>``). ``prompts[i]`` is slot i's prompt text or
+        None for a free slot. Empty list = no corruption this dispatch
+        (not armed, rate miss, or no matching slot)."""
+        fault = self._faults.get("decode")
+        if fault is None or fault.mode != "nan":
+            return []
+        targets = self._targets(prompts)
+        if not targets:
+            return []
+        if fault.rate < 1.0 and self._rng.random() >= fault.rate:
+            return []
+        self._fired["decode"] = self._fired.get("decode", 0) + 1
+        return targets
+
+    def poison_fetch(self, prompts: Sequence[Optional[str]]) -> None:
+        """``decode:poison_step`` — raise from the chunk FETCH, the
+        step-wide poison that names no slot (the bisect pass's target
+        scenario). ``prompts`` is the fetched chunk's snapshot; with a
+        ``target_substr`` the poison only fires while the target rides
+        the chunk, so innocents replayed without it drain clean."""
+        fault = self._faults.get("decode")
+        if fault is None or fault.mode != "poison_step":
+            return
+        if not self._targets(prompts):
+            return
+        if fault.rate < 1.0 and self._rng.random() >= fault.rate:
+            return
+        self._fired["decode"] = self._fired.get("decode", 0) + 1
+        raise InjectedFault("injected poisoned step at chunk fetch")
+
+    def check_scheduler_die(self) -> None:
+        """``scheduler:die`` — one-shot: raises ``SchedulerKilled`` (a
+        BaseException) so the scheduler loop genuinely dies; disarms
+        itself so the supervisor's restarted loop survives."""
+        fault = self._faults.get("scheduler")
+        if fault is None or fault.mode != "die":
+            return
+        del self._faults["scheduler"]
+        self._fired["scheduler"] = self._fired.get("scheduler", 0) + 1
+        raise SchedulerKilled("injected scheduler death")
+
     def describe(self) -> str:
         return ",".join(
-            f"{p}:{f.mode}" + (f":{f.rate}" if f.mode == "error"
+            f"{p}:{f.mode}" + (f":{f.rate}"
+                               if f.mode in ("error", "nan", "poison_step")
                                and f.rate < 1.0 else "")
             for p, f in self._faults.items()
         ) or "none"
@@ -227,6 +338,13 @@ class ChaosEngine:
     def retry_after_hint(self) -> float:
         fn = getattr(self.inner, "retry_after_hint", None)
         return float(fn()) if callable(fn) else 1.0
+
+    def set_reset_listener(self, fn) -> None:
+        """Forward the containment reset→breaker hookup to the wrapped
+        engine (the supervisor lives below this wrapper)."""
+        hook = getattr(self.inner, "set_reset_listener", None)
+        if callable(hook):
+            hook(fn)
 
     async def generate(self, prompt: str, **kwargs) -> EngineResult:
         await self.faults.acheck("generate")

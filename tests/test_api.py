@@ -458,8 +458,8 @@ async def test_openapi_document_served_and_complete():
             assert path in doc["paths"], path
         # The reference's documented status-code catalog (app.py:288-297).
         kc = doc["paths"]["/kubectl-command"]["post"]["responses"]
-        assert set(kc) == {"200", "400", "401", "422", "429", "500",
-                           "503", "504"}
+        assert set(kc) == {"200", "400", "401", "410", "422", "429",
+                           "500", "503", "504"}
         ex = doc["paths"]["/execute"]["post"]["responses"]
         assert set(ex) == {"200", "400", "401", "429", "500"}
         # Schemas come from the real pydantic models; $refs resolve.

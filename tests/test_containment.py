@@ -1,0 +1,608 @@
+"""Blast-radius containment (ISSUE 5): slot quarantine, engine
+reset-and-replay, and innocent-victim recovery.
+
+The recovery matrix, on both the numpy FakeChunkedEngine (milliseconds,
+same packed-chunk v2 contract + the same EngineSupervisor policy) and
+the real BatchedJaxEngine on CPU:
+
+- NaN in ONE slot's logits at pipe depth 3 → only that request errors
+  (410 RequestQuarantined); every cohabiting request completes with a
+  transcript BYTE-IDENTICAL to a fault-free run (greedy and sampled),
+  engine_resets_total gets the slot_health cause, and no queued request
+  is dropped across the reset.
+- Step-wide poison (raise from the chunk fetch) → bisection isolates the
+  culprit; innocents replay to parity.
+- Scheduler death → supervisor restart with zero dropped requests.
+- Retry-budget exhaustion → terminal error, not infinite replay.
+- Reset storm → the PR 1 circuit breaker opens (inner ring feeds outer).
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+from ai_agent_kubectl_tpu.engine.fake import FakeChunkedEngine
+from ai_agent_kubectl_tpu.engine.protocol import (HEALTH_NONFINITE,
+                                                  HEALTH_TOKEN_RANGE,
+                                                  RequestQuarantined,
+                                                  describe_health, pack_chunk,
+                                                  packed_chunk_size,
+                                                  unpack_chunk)
+from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
+from ai_agent_kubectl_tpu.models.config import get_config
+from ai_agent_kubectl_tpu.testing.faults import FaultInjector, SchedulerKilled
+
+# ---------------------------------------------------------------------------
+# Packed-chunk v2 schema: the health lane
+# ---------------------------------------------------------------------------
+
+
+def test_packed_chunk_v2_health_roundtrip():
+    n, c = 3, 4
+    toks = np.arange(n * c, dtype=np.int32).reshape(n, c)
+    done = np.array([True, False, False])
+    lengths = np.array([7, 9, 2], np.int32)
+    health = np.array([0, HEALTH_NONFINITE,
+                       HEALTH_NONFINITE | HEALTH_TOKEN_RANGE], np.int32)
+    buf = pack_chunk(toks, done, lengths, 1, health=health)
+    assert buf.shape == (packed_chunk_size(n, c),)
+    res = unpack_chunk(buf, n, c)
+    np.testing.assert_array_equal(res.health, health)
+    np.testing.assert_array_equal(res.tokens, toks)
+    assert res.n_alive == 1
+    # Callers predating the lane pack all-healthy.
+    res2 = unpack_chunk(pack_chunk(toks, done, lengths, 1), n, c)
+    assert not res2.health.any()
+
+
+def test_describe_health_labels():
+    assert describe_health(0) == "ok"
+    assert describe_health(HEALTH_NONFINITE) == "nonfinite_logits"
+    assert describe_health(HEALTH_TOKEN_RANGE) == "token_out_of_range"
+    assert describe_health(HEALTH_NONFINITE | HEALTH_TOKEN_RANGE) == \
+        "nonfinite_logits|token_out_of_range"
+
+
+# ---------------------------------------------------------------------------
+# Fault-spec parsing for the device-shaped points
+# ---------------------------------------------------------------------------
+
+
+def test_containment_fault_specs_parse():
+    inj = FaultInjector.from_spec("decode:nan:0.5")
+    assert inj.has("decode") and inj._faults["decode"].rate == 0.5
+    inj = FaultInjector.from_spec("decode:poison_step")
+    assert inj._faults["decode"].mode == "poison_step"
+    inj = FaultInjector.from_spec("scheduler:die")
+    assert inj._faults["scheduler"].mode == "die"
+
+
+def test_containment_fault_specs_reject_mismatches():
+    for bad in ("admit:nan", "chunk:poison_step", "generate:die",
+                "decode:error", "scheduler:hang", "decode:nan:1.5"):
+        with pytest.raises(ValueError):
+            FaultInjector.from_spec(bad)
+
+
+def test_scheduler_die_is_one_shot():
+    inj = FaultInjector.from_spec("scheduler:die")
+    with pytest.raises(SchedulerKilled):
+        inj.check_scheduler_die()
+    inj.check_scheduler_die()       # disarmed: no raise
+    assert inj.fired("scheduler") == 1
+
+
+# ---------------------------------------------------------------------------
+# FakeChunkedEngine recovery matrix (the acceptance shape: bs=48, depth 3)
+# ---------------------------------------------------------------------------
+
+
+async def _fake_reference(prompts, max_tokens=12, **kw):
+    eng = FakeChunkedEngine(**kw)
+    await eng.start()
+    base = {}
+    for p in prompts:
+        base[p] = (await eng.generate(p, max_tokens=max_tokens)).text
+    await eng.stop()
+    return base
+
+
+async def test_fake_nan_one_slot_bs48_victims_byte_identical():
+    """The acceptance scenario on the fake: decode:nan:1.0 targeting ONE
+    request in a full bs=48 batch at depth 3, with 12 more requests
+    queued behind the batch. Only the target errors (410-terminal); all
+    59 others complete byte-identical to the fault-free run; resets carry
+    the slot_health cause; zero queued requests are dropped."""
+    kw = dict(batch_size=48, chunk_len=4, chunk_pipe_depth=3)
+    prompts = [f"query number {i:02d}" for i in range(60)]
+    base = await _fake_reference(prompts, **kw)
+
+    inj = FaultInjector()
+    inj.set("decode", "nan")        # p = 1.0
+    inj.target_substr = "number 07"
+    eng = FakeChunkedEngine(faults=inj, **kw)
+    await eng.start()
+    results = await asyncio.gather(
+        *[eng.generate(p, max_tokens=12) for p in prompts],
+        return_exceptions=True)
+    await asyncio.sleep(0)
+    quarantined = [(p, r) for p, r in zip(prompts, results)
+                   if isinstance(r, BaseException)]
+    assert len(quarantined) == 1
+    assert "number 07" in quarantined[0][0]
+    assert isinstance(quarantined[0][1], RequestQuarantined)
+    for p, r in zip(prompts, results):
+        if not isinstance(r, BaseException):
+            assert r.text == base[p], f"victim {p!r} transcript changed"
+    c = eng.stats()["containment"]
+    assert c["resets"].get("slot_health", 0) >= 1
+    assert c["quarantined"] == {"slot_health": 1}
+    assert c["health_trips"] >= 1
+    assert c["replayed_tokens"] > 0
+    assert eng.stats()["queue_depth"] == 0   # nothing stranded
+    await eng.stop()
+
+
+async def test_fake_reference_runs_are_deterministic():
+    """Byte-parity assertions above are only meaningful if a fault-free
+    rerun reproduces itself exactly."""
+    kw = dict(batch_size=4, chunk_len=4, chunk_pipe_depth=3)
+    prompts = [f"determinism probe {i}" for i in range(6)]
+    assert await _fake_reference(prompts, **kw) == \
+        await _fake_reference(prompts, **kw)
+
+
+async def test_fake_poison_step_bisect_isolates_culprit():
+    """decode:poison_step names no slot: bisection must park/replay its
+    way down to the one request whose presence poisons the step, fail
+    only it, and recover every innocent to byte parity."""
+    kw = dict(batch_size=8, chunk_len=4, chunk_pipe_depth=3)
+    prompts = [f"bisect probe {i}" for i in range(8)]
+    base = await _fake_reference(prompts, **kw)
+
+    inj = FaultInjector()
+    inj.set("decode", "poison_step")
+    inj.target_substr = "probe 5"
+    eng = FakeChunkedEngine(faults=inj, **kw)
+    await eng.start()
+    results = await asyncio.gather(
+        *[eng.generate(p, max_tokens=12) for p in prompts],
+        return_exceptions=True)
+    for p, r in zip(prompts, results):
+        if "probe 5" in p:
+            assert isinstance(r, RequestQuarantined)
+        else:
+            assert not isinstance(r, BaseException), (p, r)
+            assert r.text == base[p]
+    c = eng.stats()["containment"]
+    assert c["quarantined"] == {"step_poison": 1}
+    # Bisection takes multiple resets (8 → 4 → ... → 1 → confirm).
+    assert c["resets"].get("scheduler_error", 0) >= 3
+    await eng.stop()
+
+
+async def test_fake_probation_unparks_early_and_still_converges():
+    """Bisection probation must NOT stall admissions until the probe
+    drains its whole remaining decode: after PROBATION_CLEAN_CHUNKS clean
+    chunks, suspicion narrows to the parked half and it replays (a short
+    request submitted mid-probation completes within a few chunks, not
+    after the long probes finish) — while the standing suspect pool keeps
+    the re-mixed bisection converging on the culprit in a bounded number
+    of resets instead of restarting from the full batch every round."""
+    import zlib as _zlib
+
+    def long_stream(prompt):
+        h = _zlib.crc32(prompt.encode())
+        return [7 + ((h >> (i % 24)) + 3 * i) % 200
+                for i in range(60)] + [2]
+
+    kw = dict(batch_size=8, chunk_len=4, chunk_pipe_depth=3,
+              stream_fn=long_stream, reset_max_per_min=0)
+    longs = [f"bisect probe {i}" for i in range(6)]
+    base = await _fake_reference(longs, max_tokens=40, **kw)
+    eng0 = FakeChunkedEngine(**kw)
+    await eng0.start()
+    base_short = (await eng0.generate("late arrival", max_tokens=4)).text
+    await eng0.stop()
+
+    inj = FaultInjector()
+    inj.set("decode", "poison_step")
+    inj.target_substr = "probe 5"        # lands in the parked half
+    eng = FakeChunkedEngine(faults=inj, **kw)
+    await eng.start()
+    tasks = [asyncio.create_task(eng.generate(p, max_tokens=40))
+             for p in longs]
+    for _ in range(4000):                # wait for the first reset
+        await asyncio.sleep(0)
+        if eng.stats()["containment"]["resets"]:
+            break
+    else:
+        pytest.fail("fault never tripped containment")
+    consumed_at_submit = eng.stats()["chunks_consumed"]
+    short = await eng.generate("late arrival", max_tokens=4)
+    chunks_waited = eng.stats()["chunks_consumed"] - consumed_at_submit
+    # Old behaviour held admissions until the 40-token probes drained
+    # (≥ 10 chunks); early exoneration admits after ≤ 2 clean chunks.
+    assert chunks_waited <= 8, chunks_waited
+    assert short.text == base_short
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    for p, r in zip(longs, results):
+        if "probe 5" in p:
+            assert isinstance(r, RequestQuarantined)
+        else:
+            assert not isinstance(r, BaseException), (p, r)
+            assert r.text == base[p]
+    c = eng.stats()["containment"]
+    assert c["quarantined"] == {"step_poison": 1}
+    # Suspect-pool narrowing: ~log2(6) splits + the budgeted confirm —
+    # NOT a fresh full-batch bisection per probation round.
+    assert 3 <= sum(c["resets"].values()) <= 8, c["resets"]
+    await eng.stop()
+
+
+async def test_fake_scheduler_die_restart_zero_dropped():
+    """Scheduler-loop death mid-flight: the supervisor restarts it after
+    a reset; active requests replay to parity and queued requests (bs=2,
+    8 submitted) all complete — zero dropped. Long scripted streams +
+    an explicit mid-flight poll make the kill land while requests are
+    genuinely decoding (and others genuinely queued)."""
+    import zlib as _zlib
+
+    def long_stream(prompt):
+        h = _zlib.crc32(prompt.encode())
+        return [7 + ((h >> (i % 24)) + 3 * i) % 200
+                for i in range(40)] + [2]
+
+    kw = dict(batch_size=2, chunk_len=4, chunk_pipe_depth=3,
+              stream_fn=long_stream)
+    prompts = [f"die probe {i}" for i in range(8)]
+    base = await _fake_reference(prompts, max_tokens=30, **kw)
+
+    inj = FaultInjector()
+    eng = FakeChunkedEngine(faults=inj, **kw)
+    await eng.start()
+    tasks = [asyncio.create_task(eng.generate(p, max_tokens=30))
+             for p in prompts]
+    for _ in range(2000):           # mid-flight: decoding AND queued
+        await asyncio.sleep(0)
+        if (any(s is not None and len(s.emitted) >= 3
+                for s in eng._slots) and eng._queue):
+            break
+    else:
+        pytest.fail("engine never reached the mid-flight state")
+    inj.set("scheduler", "die")
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    assert not [r for r in results if isinstance(r, BaseException)]
+    assert [r.text for r in results] == [base[p] for p in prompts]
+    assert eng.stats()["containment"]["resets"] == {"scheduler_death": 1}
+    await eng.stop()
+
+
+async def test_fake_retry_budget_exhaustion_is_terminal():
+    """QUARANTINE_RETRY_BUDGET bounds the replays of a repeat offender:
+    budget 0 quarantines on the first trip (one reset); budget 2 allows
+    two replays then goes terminal (three resets) — never an infinite
+    replay loop."""
+    for budget, want_resets in ((0, 1), (2, 3)):
+        inj = FaultInjector()
+        inj.set("decode", "nan")
+        inj.target_substr = "poison me"
+        eng = FakeChunkedEngine(batch_size=2, chunk_len=4,
+                                chunk_pipe_depth=3, faults=inj,
+                                quarantine_retry_budget=budget)
+        await eng.start()
+        with pytest.raises(RequestQuarantined):
+            await eng.generate("poison me please", max_tokens=12)
+        c = eng.stats()["containment"]
+        assert c["resets"] == {"slot_health": want_resets}, budget
+        assert c["quarantined"] == {"slot_health": 1}
+        await eng.stop()
+
+
+async def test_fake_reset_storm_opens_breaker():
+    """Inner ring feeds outer ring: every reset reports to the breaker,
+    and once the reset budget is spent the engine fails fast — a
+    flapping engine ends up behind an OPEN breaker instead of thrashing."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ai_agent_kubectl_tpu.config import ServiceConfig
+    from ai_agent_kubectl_tpu.server.app import create_app
+    from ai_agent_kubectl_tpu.server.executor import CommandExecutor
+
+    inj = FaultInjector()
+    inj.set("decode", "poison_step")    # indiscriminate: a true storm
+    eng = FakeChunkedEngine(batch_size=2, chunk_len=4, chunk_pipe_depth=3,
+                            faults=inj, quarantine_retry_budget=99,
+                            reset_max_per_min=2)
+    cfg = ServiceConfig(engine="fake", model_name="fake", llm_timeout=5.0,
+                        breaker_threshold=3, breaker_window_secs=60.0)
+    app = create_app(cfg, eng, executor=CommandExecutor(timeout=2.0))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        svc = app["service"]
+        assert eng.supervisor.on_reset is not None   # listener wired
+        statuses = []
+        for i in range(4):
+            resp = await client.post("/kubectl-command",
+                                     json={"query": f"storm request {i}"})
+            statuses.append(resp.status)
+            if svc.breaker.state == "open":
+                break
+        assert svc.breaker.state == "open", statuses
+        health = await (await client.get("/health")).json()
+        assert health["breaker"] == "open"
+        assert health["last_reset_cause"] == "scheduler_error"
+        assert health["last_reset"] is not None
+    finally:
+        await client.close()
+
+
+async def test_containment_metrics_and_health_exposed():
+    """/metrics carries the four containment series after a quarantine
+    and /health reports the last reset time + cause."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ai_agent_kubectl_tpu.config import ServiceConfig
+    from ai_agent_kubectl_tpu.server.app import create_app
+    from ai_agent_kubectl_tpu.server.executor import CommandExecutor
+
+    inj = FaultInjector()
+    inj.set("decode", "nan")
+    inj.target_substr = "poisoned query"
+    eng = FakeChunkedEngine(batch_size=4, chunk_len=4, chunk_pipe_depth=3,
+                            faults=inj)
+    cfg = ServiceConfig(engine="fake", model_name="fake", llm_timeout=5.0)
+    app = create_app(cfg, eng, executor=CommandExecutor(timeout=2.0))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post("/kubectl-command",
+                                 json={"query": "poisoned query please"})
+        assert resp.status == 410
+        assert "quarantined" in (await resp.json())["detail"]
+        text = await (await client.get("/metrics")).text()
+        assert 'engine_resets_total{cause="slot_health"}' in text
+        assert 'quarantined_requests_total{reason="slot_health"}' in text
+        assert "replayed_tokens_total" in text
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("slot_health_trips_total")][0]
+        assert float(line.split()[-1]) >= 1
+        health = await (await client.get("/health")).json()
+        assert health["last_reset_cause"] == "slot_health"
+        assert health["last_reset"]
+    finally:
+        await client.close()
+
+
+# ---------------------------------------------------------------------------
+# BatchedJaxEngine on CPU — the real inner ring end to end
+# ---------------------------------------------------------------------------
+
+#: the acceptance geometry: a FULL bs=48 batch at CHUNK_PIPE_DEPTH=3,
+#: with 4 more requests queued behind it. Greedy bulk + four sampled
+#: (temperature 0.9, pinned seeds) requests so byte-parity also proves
+#: the seeded-replay RNG contract at temperature > 0.
+#: one prefill bucket (every prompt AND every replay prefix fits 16
+#: tokens) keeps the two bs=48 engine startups inside the tier-1 budget.
+JAX_KW = dict(dtype="float32", max_seq_len=64, prefill_buckets=(16,),
+              prefix_cache=False, compile_cache_dir="",
+              batch_size=48, chunk_len=4, chunk_pipe_depth=3)
+N_REQS = 52
+TARGET = "pod q7 "
+
+
+def _jax_requests():
+    # (prompt, temperature, seed) — prompts unique and short (bucket 16).
+    reqs = []
+    for i in range(N_REQS):
+        temp = 0.9 if i % 13 == 3 else 0.0
+        reqs.append((f"pod q{i} ", temp, 1000 + i))
+    return reqs
+
+
+async def _run_jax(engine):
+    reqs = _jax_requests()
+    results = await asyncio.gather(
+        *[engine.generate(p, max_tokens=8, temperature=t, seed=s)
+          for p, t, s in reqs],
+        return_exceptions=True)
+    return {p: (r if isinstance(r, BaseException) else r.text)
+            for (p, _, _), r in zip(reqs, results)}
+
+
+@pytest.fixture(scope="module")
+def jax_base():
+    eng = BatchedJaxEngine(get_config("toy-8m"), tokenizer=ByteTokenizer(),
+                          **JAX_KW)
+    asyncio.run(eng.start())
+    try:
+        base = asyncio.run(_run_jax(eng))
+    finally:
+        asyncio.run(eng.stop())
+    assert not any(isinstance(v, BaseException) for v in base.values())
+    return base
+
+
+@pytest.fixture(scope="module")
+def jax_faulted():
+    inj = FaultInjector()
+    eng = BatchedJaxEngine(get_config("toy-8m"), tokenizer=ByteTokenizer(),
+                          faults=inj, **JAX_KW)
+    asyncio.run(eng.start())
+    yield eng, inj
+    asyncio.run(eng.stop())
+
+
+async def test_jax_nan_one_slot_bs48_victims_byte_identical(jax_base,
+                                                            jax_faulted):
+    """THE acceptance criterion: decode:nan:1.0 targeting one request in
+    a full bs=48 batch at depth 3 on the real engine. Only the target
+    errors; all 51 cohabitants/queued complete byte-identical to the
+    fault-free run (including the temperature-0.9 ones — seeded-replay
+    RNG parity); engine resets carry the slot_health cause; nothing
+    queued is dropped."""
+    eng, inj = jax_faulted
+    inj.set("decode", "nan")        # p = 1.0
+    inj.target_substr = TARGET
+    try:
+        out = await _run_jax(eng)
+    finally:
+        inj.clear()
+    bad = {p: v for p, v in out.items() if isinstance(v, BaseException)}
+    assert list(bad) == [TARGET]
+    assert isinstance(bad[TARGET], RequestQuarantined)
+    for p, text in out.items():
+        if p != TARGET:
+            assert text == jax_base[p], f"victim {p!r} transcript changed"
+    c = eng.stats()["containment"]
+    assert c["resets"].get("slot_health", 0) >= 1
+    assert c["quarantined"] == {"slot_health": 1}
+    assert c["health_trips"] >= 1
+    assert c["replayed_tokens"] > 0
+    assert eng.stats()["queue_depth"] == 0
+
+
+async def test_jax_poison_step_isolates_culprit(jax_base, jax_faulted):
+    """Step-wide poison on the real engine (raised from the chunk fetch):
+    bisection quarantines exactly the target; a small cohort of innocents
+    replays to byte parity."""
+    eng, inj = jax_faulted
+    cohort = [r for r in _jax_requests()[:6]]
+    inj.set("decode", "poison_step")
+    inj.target_substr = "pod q3 "
+    try:
+        results = await asyncio.gather(
+            *[eng.generate(p, max_tokens=8, temperature=t, seed=s)
+              for p, t, s in cohort],
+            return_exceptions=True)
+    finally:
+        inj.clear()
+    for (p, _, _), r in zip(cohort, results):
+        if p == "pod q3 ":
+            assert isinstance(r, RequestQuarantined)
+        else:
+            assert not isinstance(r, BaseException), (p, r)
+            assert r.text == jax_base[p]
+    assert eng.stats()["containment"]["quarantined"].get("step_poison") == 1
+
+
+async def test_jax_scheduler_die_restart_zero_dropped(jax_base, jax_faulted):
+    """Kill the scheduler THREAD mid-decode: the supervisor thread
+    resets, replays survivors, restarts the loop; every request —
+    including ones still queued at death — completes to parity."""
+    eng, inj = jax_faulted
+    cohort = [r for r in _jax_requests()[6:12]]
+    tasks = [asyncio.create_task(
+        eng.generate(p, max_tokens=8, temperature=t, seed=s))
+        for p, t, s in cohort]
+    for _ in range(400):            # wait until genuinely decoding
+        await asyncio.sleep(0.005)
+        if any(s is not None and len(s.detok.ids) >= 1
+               for s in eng._slots):
+            break
+    inj.set("scheduler", "die")
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    assert not [r for r in results if isinstance(r, BaseException)]
+    for (p, _, _), r in zip(cohort, results):
+        assert r.text == jax_base[p]
+    for _ in range(400):            # the kill may land after the drain
+        if eng.stats()["containment"]["resets"].get("scheduler_death"):
+            break
+        await asyncio.sleep(0.01)
+    assert eng.stats()["containment"]["resets"].get("scheduler_death", 0) >= 1
+
+
+async def test_jax_scheduler_die_mid_admission_request_recovered(
+        jax_base, jax_faulted):
+    """A BaseException striking INSIDE an admission — after the request
+    was popped from the queue but before it reached a slot — leaves it
+    in neither _slots nor the queue. The supervisor must requeue such
+    popped-but-unsettled requests on restart instead of leaking a
+    generate() that blocks forever."""
+    eng, inj = jax_faulted
+    prompt, temp, seed = _jax_requests()[20]
+    real_admit = eng._admit_one
+    killed = []
+
+    def admit_and_die(req):
+        if req.prompt == prompt and not killed:
+            killed.append(True)
+            raise SchedulerKilled("injected mid-admission death")
+        return real_admit(req)
+
+    eng._admit_one = admit_and_die
+    try:
+        r = await asyncio.wait_for(
+            eng.generate(prompt, max_tokens=8, temperature=temp,
+                         seed=seed),
+            timeout=120)
+    finally:
+        eng._admit_one = real_admit
+    assert killed, "fault never armed: admission path changed?"
+    assert r.text == jax_base[prompt]
+    assert eng.stats()["containment"]["resets"].get(
+        "scheduler_death", 0) >= 1
+
+
+async def test_jax_seed_exposed_in_trace(jax_faulted):
+    """The per-request sampling seed rides the trace — what makes any
+    transcript reproducible offline via /debug/requests/{id}."""
+    from ai_agent_kubectl_tpu.obs import Trace, use_trace
+
+    eng, _ = jax_faulted
+    trace = Trace("seed-probe")
+    with use_trace(trace):
+        await eng.generate("pod seedy", max_tokens=4, temperature=0.0,
+                           seed=424242)
+    events = " | ".join(e["message"] for e in trace.to_dict()["events"])
+    assert "sampling seed 424242" in events
+
+
+async def test_jax_explicit_seed_pins_sampled_transcript(jax_faulted):
+    """Same (prompt, seed, temperature>0) → same transcript; different
+    seed → (overwhelmingly) different transcript. The offline-repro
+    contract the seed satellite promises."""
+    eng, _ = jax_faulted
+    a = await eng.generate("pod pin", max_tokens=8, temperature=1.0,
+                           seed=7)
+    b = await eng.generate("pod pin", max_tokens=8, temperature=1.0,
+                           seed=7)
+    c = await eng.generate("pod pin", max_tokens=8, temperature=1.0,
+                           seed=8)
+    assert a.text == b.text
+    assert (a.text != c.text or a.completion_tokens != c.completion_tokens
+            or True)  # different seed may coincide on tiny vocab; the
+    # hard guarantee under test is same-seed determinism above.
+
+
+@pytest.mark.slow
+async def test_jax_reset_budget_exhaustion_fails_fast(jax_base):
+    """Reset storm on the real engine: past ENGINE_RESET_MAX_PER_MIN the
+    engine stops resetting and fails the affected requests fast (the
+    breaker's food) instead of thrashing. Marked slow (it builds a third
+    jax engine); tier-1 covers the same policy on the fake
+    (test_fake_reset_storm_opens_breaker) plus the reset→breaker wiring."""
+    inj = FaultInjector()
+    inj.set("decode", "poison_step")    # no target: every fetch poisons
+    eng = BatchedJaxEngine(get_config("toy-8m"), tokenizer=ByteTokenizer(),
+                          faults=inj,
+                          quarantine_retry_budget=99,
+                          reset_max_per_min=2,
+                          **{k: v for k, v in JAX_KW.items()
+                             if k != "batch_size"}, batch_size=2)
+    await eng.start()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as ei:
+            await eng.generate("pod storm", max_tokens=8, temperature=0.0,
+                               timeout=30.0)
+        assert not isinstance(ei.value, RequestQuarantined)
+        assert time.monotonic() - t0 < 25.0     # failed fast, no 30s hang
+        c = eng.stats()["containment"]
+        assert sum(c["resets"].values()) == 2   # capped, then fail-fast
+    finally:
+        await eng.stop()

@@ -85,9 +85,9 @@ async def test_batched_serving_dp_ep_tp_mesh_greedy_parity():
         # The *serving* decode-chunk program carries the EP all-to-alls.
         bucket = eng._kv_buckets[0]
         lowered = eng._batch_chunk_fns[bucket].lower(
-            eng.params, eng._tok_d, eng._pos_d, eng._cache, eng._key_d,
+            eng.params, eng._tok_d, eng._pos_d, eng._cache, eng._seeds_d,
             eng._temps_d, jnp.zeros((eng.batch_size,), jnp.bool_),
-            eng._active_d, eng._ngen_d, eng._budget_d,
+            eng._active_d, eng._ngen_d, eng._budget_d, eng._no_corrupt_d,
         )
         hlo = lowered.compile().as_text()
         assert hlo.count("all-to-all") >= 2, \
@@ -194,9 +194,9 @@ async def test_batched_serving_pp_tp_mesh_greedy_parity():
         import jax.numpy as jnp
 
         hlo = eng._batch_chunk_fns[bucket].lower(
-            eng.params, eng._tok_d, eng._pos_d, eng._cache, eng._key_d,
+            eng.params, eng._tok_d, eng._pos_d, eng._cache, eng._seeds_d,
             eng._temps_d, jnp.zeros((eng.batch_size,), jnp.bool_),
-            eng._active_d, eng._ngen_d, eng._budget_d,
+            eng._active_d, eng._ngen_d, eng._budget_d, eng._no_corrupt_d,
         ).compile().as_text()
         assert "collective-permute" in hlo, \
             "expected the pipeline stage relay in the serving HLO"

@@ -130,6 +130,41 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
     log(f"  wasted decode steps total   {wasted:>8.0f}")
     if consumed:
         log(f"  wasted steps / consumed chunk {wasted / consumed:>8.2f}")
+    print_containment_summary(gauges)
+
+
+def _sum_labelled(gauges: Dict[str, float], name: str) -> Dict[str, float]:
+    """All samples of a labelled counter: {'cause="x"': v, ...} summed by
+    the (single) label value; the bare name matches unlabelled series."""
+    out: Dict[str, float] = {}
+    for key, v in gauges.items():
+        if key == name:
+            out[""] = v
+        elif key.startswith(name + "{"):
+            out[key[len(name) + 1:-1]] = v
+    return out
+
+
+def print_containment_summary(gauges: Dict[str, float]) -> None:
+    """Reset/quarantine counters (ISSUE 5 inner ring) from the same
+    /metrics scrape: how often the engine reset-and-replayed, why, how
+    many requests were terminally quarantined, and how many
+    already-generated tokens were regenerated for innocent victims."""
+    resets = _sum_labelled(gauges, "engine_resets_total")
+    quar = _sum_labelled(gauges, "quarantined_requests_total")
+    trips = gauges.get("slot_health_trips_total")
+    if trips is None and not resets and not quar:
+        return      # engine without the containment subsystem
+    log("probe[containment]: blast-radius containment")
+    log(f"  engine resets total         {sum(resets.values()):>8.0f}"
+        + (f"  ({', '.join(f'{k}={v:.0f}' for k, v in resets.items())})"
+           if resets else ""))
+    log(f"  quarantined requests total  {sum(quar.values()):>8.0f}"
+        + (f"  ({', '.join(f'{k}={v:.0f}' for k, v in quar.items())})"
+           if quar else ""))
+    log(f"  slot health trips total     {trips or 0:>8.0f}")
+    log(f"  replayed tokens total       "
+        f"{gauges.get('replayed_tokens_total', 0.0):>8.0f}")
 
 
 async def http_probe(args) -> None:
@@ -259,7 +294,8 @@ async def main() -> None:
     # ---- decode-chunk ceiling (stops the scheduler, drives programs) ----
     await eng.stop()
     cache, tokd, posd, temps = eng._cache, eng._tok_d, eng._pos_d, eng._temps_d
-    key = jax.random.PRNGKey(0)
+    seeds = eng._seeds_d
+    no_corrupt = eng._no_corrupt_d
     # Every slot force-live with an unreachable budget: the ceiling wants
     # all lanes decoding for the whole chained run, never terminating.
     # active/ngen are donated carries — feed fresh all-live state every
@@ -278,16 +314,16 @@ async def main() -> None:
     for kv_b in eng._kv_buckets:
         fn = eng._batch_chunk_fns[kv_b]
         active, ngen = all_live()
-        packed, tokd, posd, cache, key, _, _ = fn(
-            eng.params, tokd, posd, cache, key, temps, force, active, ngen,
-            budget)
+        packed, tokd, posd, cache, _, _ = fn(
+            eng.params, tokd, posd, cache, seeds, temps, force, active, ngen,
+            budget, no_corrupt)
         _sync(packed)
         t0 = time.monotonic()
         for _ in range(args.reps):
             active, ngen = all_live()
-            packed, tokd, posd, cache, key, _, _ = fn(
-                eng.params, tokd, posd, cache, key, temps, force, active,
-                ngen, budget)
+            packed, tokd, posd, cache, _, _ = fn(
+                eng.params, tokd, posd, cache, seeds, temps, force, active,
+                ngen, budget, no_corrupt)
         _sync(packed)
         dt = (time.monotonic() - t0) / args.reps
         per_step = dt / eng.chunk_len * 1000
