@@ -213,6 +213,28 @@ class ServiceConfig:
     # fitting beside 7B int8 weights. 0 = uncapped.
     admit_scratch_mb: int = 512             # ADMIT_SCRATCH_MB
 
+    # --- engine fleet (engine/fleet.py; ROADMAP item 5's router step) ---
+    # Replicated engines behind one facade: N engine replicas with
+    # health-aware routing, cross-replica migration (seeded replay makes
+    # a migrated request's transcript bit-identical), zero-downtime
+    # drains, and hedged re-dispatch. 1 = no fleet layer (the default:
+    # single engine, zero overhead).
+    fleet_size: int = 1                     # FLEET_SIZE
+    # Hedged re-dispatch: if the chosen replica produces no event within
+    # this budget, the same request (same seed — identical bytes) is
+    # raced on a second replica. 0 disables.
+    fleet_hedge_ms: float = 0.0             # FLEET_HEDGE_MS
+    # Prefix-affinity routing: keep multi-turn /execute agent loops on
+    # the replica already holding their KV prefix.
+    fleet_affinity: bool = True             # FLEET_AFFINITY
+    # How many times one request may migrate across replicas before its
+    # error propagates (bounds pathological flapping).
+    fleet_migration_budget: int = 3         # FLEET_MIGRATION_BUDGET
+    # Auto-rejoin: restart an ejected replica after this many seconds
+    # (each rejoin needs a successful engine start). 0 = manual rejoin
+    # only (drain/eject leaves the replica down until an operator acts).
+    fleet_rejoin_secs: float = 0.0          # FLEET_REJOIN_SECS
+
     # --- overload protection / failure containment ---
     # Bounded admission: the batcher sheds work with a fast 503 +
     # Retry-After once this many requests are queued for a decode slot,
@@ -319,7 +341,11 @@ class ServiceConfig:
             log_format=(_env_str("LOG_FORMAT", "text") or "text").lower(),
             host=_env_str("HOST", "0.0.0.0"),
             port=_env_int("PORT", 8000),
-            trust_proxy_headers=_env_bool("TRUST_PROXY_HEADERS", False),
+            # TRUST_PROXY is the conventional short alias (fronting
+            # router tiers set it); TRUST_PROXY_HEADERS wins when both
+            # are present.
+            trust_proxy_headers=_env_bool(
+                "TRUST_PROXY_HEADERS", _env_bool("TRUST_PROXY", False)),
             engine=(_env_str("ENGINE", "jax") or "jax").lower(),
             model_name=_env_str("MODEL_NAME", "toy-8m"),
             model_path=_env_str("MODEL_PATH", None),
@@ -346,6 +372,11 @@ class ServiceConfig:
             engine_startup_grace_secs=_env_float(
                 "ENGINE_STARTUP_GRACE_SECS", 900.0),
             admit_scratch_mb=_env_int("ADMIT_SCRATCH_MB", 512),
+            fleet_size=_env_int("FLEET_SIZE", 1),
+            fleet_hedge_ms=_env_float("FLEET_HEDGE_MS", 0.0),
+            fleet_affinity=_env_bool("FLEET_AFFINITY", True),
+            fleet_migration_budget=_env_int("FLEET_MIGRATION_BUDGET", 3),
+            fleet_rejoin_secs=_env_float("FLEET_REJOIN_SECS", 0.0),
             max_queue_depth=_env_int("MAX_QUEUE_DEPTH", 64),
             max_inflight_requests=_env_int("MAX_INFLIGHT_REQUESTS", 256),
             degraded_fallback=_env_bool("DEGRADED_FALLBACK", False),

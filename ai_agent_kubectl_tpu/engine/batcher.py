@@ -56,8 +56,9 @@ from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
 from .jax_engine import JaxEngine
 from .protocol import (HEALTH_NONFINITE, HEALTH_TOKEN_RANGE, EngineOverloaded,
                        EngineResult, EngineUnavailable, GenerationTimeout,
-                       RequestQuarantined, consume_chunk_row, describe_health,
-                       pack_chunk, scan_chunk_row, unpack_chunk)
+                       RequestExport, RequestQuarantined, consume_chunk_row,
+                       describe_health, pack_chunk, scan_chunk_row,
+                       unpack_chunk)
 from .sampling import eos_mask, sample_tokens_seeded
 from .tokenizer import StreamDecoder
 
@@ -225,6 +226,21 @@ class _Request:
     # and new admissions into the batch without widening the next
     # bisection back out to everyone.
     suspect: bool = False
+    # Cross-replica migration (engine/fleet.py): ``resume_ids`` imports a
+    # generated-so-far prefix from ANOTHER engine — admission re-splices
+    # prompt + prefix exactly like a containment replay (ngen0 re-aligns
+    # the per-request RNG stream, so the continuation is bit-identical)
+    # and re-emits the prefix text, which the fleet relay suppresses.
+    # ``export`` is the live outbound view: the scheduler points its
+    # ``ids`` at the generated ids after every consume, so the fleet can
+    # carry this request to a healthy replica when this engine dies.
+    resume_ids: Optional[List[int]] = None
+    export: Optional[RequestExport] = None
+    # True once _admit_resume has emitted the imported prefix text: a
+    # scheduler-death mid-admission requeues the request, and the second
+    # _admit_resume pass must not emit the prefix a second time (the
+    # fleet's suppression window was already consumed by the first).
+    resume_emitted: bool = False
 
 
 @dataclasses.dataclass
@@ -1731,6 +1747,11 @@ class BatchedJaxEngine(JaxEngine):
         admissions always agree."""
         if self._prefix is None:
             return None
+        if req.resume_ids:
+            # Migrated-in requests re-splice through the single replay
+            # path (their KV is prompt + generated prefix, not a
+            # prefix-cache suffix shape).
+            return None
         ids = req.prompt_ids
         max_prompt = self.max_seq_len - max(1, req.max_tokens)
         if len(ids) > max_prompt or not self._prefix.matches(ids):
@@ -1964,6 +1985,9 @@ class BatchedJaxEngine(JaxEngine):
             self._emit(req, "error",
                        GenerationTimeout("timed out waiting for a slot"))
             return
+        if req.resume_ids:
+            self._admit_resume(req)
+            return
         slot_idx = self._slots.index(None)
         t_adm = time.monotonic()
 
@@ -2016,6 +2040,52 @@ class BatchedJaxEngine(JaxEngine):
         self._inflight.append(("first", first_tok_d, req, slot_idx))
         self._last_admit_t = time.monotonic()
 
+    def _admit_resume(self, req: _Request) -> None:
+        """Cross-replica import (fleet migration): seat a request that
+        already generated tokens on ANOTHER engine. The portable tuple
+        (prompt, resume_ids, seed) re-splices through the SAME replay
+        path containment uses — one prefill of prompt + prefix[:-1],
+        carry token forced to the last generated id, ngen0 re-aligning
+        the RNG stream — so the continuation is bit-identical to the
+        donor's would-have-been transcript. The prefix TEXT is re-emitted
+        first (one token event); the fleet relay suppresses it against
+        what the client already received, which also makes an engine
+        without import support (replay-from-scratch) behave identically
+        from the fleet's view."""
+        t_adm = time.monotonic()
+        detok = StreamDecoder(self.tokenizer)
+        piece = detok.push(*req.resume_ids)
+        if req.resume_emitted:
+            piece = None          # requeued after a mid-admission death
+        req.resume_emitted = True
+        slot = _Slot(
+            req=req,
+            detok=detok,
+            n_prompt=len(req.prompt_ids),
+            pos=0,                # set by _replay_slot's splice
+            queue_ms=(t_adm - req.t_submit) * 1000.0,
+            t_admit=t_adm,
+            t_decode0=t_adm,
+        )
+        if piece is not None:
+            self._emit(req, "token", piece)
+        if req.export is not None:
+            req.export.ids = list(detok.ids)
+        if req.trace is not None:
+            req.trace.event(
+                f"engine: importing migrated request "
+                f"({len(req.resume_ids)} generated tokens, seed {req.seed})")
+        if len(detok.ids) >= req.max_tokens:
+            # The imported prefix already spends the budget: finish
+            # through the normal path (flush + done event) without ever
+            # touching the device.
+            slot_idx = self._slots.index(None)
+            slot.t_first = t_adm
+            self._slots[slot_idx] = slot
+            self._finish(slot_idx, "length")
+            return
+        self._replay_slot(slot)
+
     def _consume_first(self, first_tok: int, req: _Request,
                        slot_idx: int) -> None:
         """Deliver an admission's first token (already fetched). EOS /
@@ -2041,6 +2111,8 @@ class BatchedJaxEngine(JaxEngine):
         t_dk = time.monotonic()
         piece = slot.detok.push(first_tok)
         slot.detok_ms += (time.monotonic() - t_dk) * 1000.0
+        if req.export is not None:
+            req.export.ids = list(slot.detok.ids)
         if piece is not None:
             self._emit(req, "token", piece)
         if req.max_tokens <= 1:
@@ -2335,6 +2407,11 @@ class BatchedJaxEngine(JaxEngine):
                 t_dk = time.monotonic()
                 piece = slot.detok.push(*new_ids)
                 slot.detok_ms += (time.monotonic() - t_dk) * 1000.0
+                # Keep the portable export current: a fresh list per
+                # update, so the fleet's cross-thread read always sees a
+                # settled snapshot of the generated prefix.
+                if slot.req.export is not None:
+                    slot.req.export.ids = list(slot.detok.ids)
                 if piece is not None:
                     self._emit(slot.req, "token", piece)
             if slot.req.trace is not None:
@@ -2446,9 +2523,27 @@ class BatchedJaxEngine(JaxEngine):
 
     # ------------------------------------------------------------ serving
 
+    async def stream_events(self, prompt: str, *, max_tokens: int = 128,
+                            temperature: float = 0.0,
+                            timeout: Optional[float] = None,
+                            seed: Optional[int] = None,
+                            resume_ids: Optional[List[int]] = None,
+                            export: Optional[RequestExport] = None):
+        """Fleet-facing event stream (engine/fleet.py): the full
+        cross-replica contract — pinned seed, ``resume_ids`` import
+        (re-splice a prefix generated elsewhere), live ``export`` of the
+        generated ids for migration off THIS engine."""
+        async for ev in self._stream_events(
+                prompt, max_tokens=max_tokens, temperature=temperature,
+                timeout=timeout, seed=seed, resume_ids=resume_ids,
+                export=export):
+            yield ev
+
     async def _stream_events(self, prompt: str, *, max_tokens: int,
                              temperature: float, timeout: Optional[float],
-                             seed: Optional[int] = None):
+                             seed: Optional[int] = None,
+                             resume_ids: Optional[List[int]] = None,
+                             export: Optional[RequestExport] = None):
         if not self._ready:
             raise EngineUnavailable("engine not started")
         # Per-request sampling seed: explicit when the caller pins one,
@@ -2490,6 +2585,8 @@ class BatchedJaxEngine(JaxEngine):
             trace=trace,
             seed=seed,
             prompt=prompt,
+            resume_ids=list(resume_ids) if resume_ids else None,
+            export=export,
         )
         if trace is not None:
             trace.event(f"engine: submitted to batch scheduler "

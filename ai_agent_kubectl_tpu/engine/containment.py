@@ -96,6 +96,13 @@ class EngineSupervisor:
         self.replayed_requests = 0
         self.last_reset_wall: Optional[float] = None   # time.time()
         self.last_reset_cause: Optional[str] = None
+        #: fleet escalation (engine/fleet.py): how often allow_reset()
+        #: said NO — the signal that this engine stopped recovering and
+        #: degraded to fail-fast. The fleet monitor reads the wall stamp
+        #: to label the ensuing ejection "reset_budget_exhausted"
+        #: (replace/rejoin the replica) instead of a generic not-ready.
+        self.budget_denials = 0
+        self.last_denial_wall: Optional[float] = None
         #: optional listener invoked (cause) AFTER each recorded reset —
         #: the service layer wires this to the PR 1 circuit breaker so a
         #: reset storm opens it even while individual requests recover.
@@ -111,7 +118,11 @@ class EngineSupervisor:
             return True
         with self._lock:
             self._prune_locked()
-            return len(self._reset_times) < self.max_resets_per_min
+            allowed = len(self._reset_times) < self.max_resets_per_min
+            if not allowed:
+                self.budget_denials += 1
+                self.last_denial_wall = time.time()
+            return allowed
 
     def _prune_locked(self) -> None:
         horizon = self._timer() - 60.0
@@ -182,4 +193,5 @@ class EngineSupervisor:
                 "max_resets_per_min": self.max_resets_per_min,
                 "last_reset_wall": self.last_reset_wall,
                 "last_reset_cause": self.last_reset_cause,
+                "budget_denials": self.budget_denials,
             }

@@ -29,7 +29,7 @@ from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
                           REASON_HEALTH, REASON_ISOLATED, EngineSupervisor)
 from .fallback import extract_query, rule_command  # rules promoted there
 from .protocol import (HEALTH_NONFINITE, EngineResult, EngineUnavailable,
-                       GenerationTimeout, RequestQuarantined,
+                       GenerationTimeout, RequestExport, RequestQuarantined,
                        consume_chunk_row, pack_chunk, scan_chunk_row,
                        unpack_chunk)
 
@@ -127,6 +127,8 @@ class _FakeReq:
                                   # replay parity with the real contract)
     suspect_count: int = 0        # quarantine implications (containment)
     suspect: bool = False         # in the standing bisection pool
+    resume_ids: Optional[List[int]] = None   # fleet migration import
+    export: Optional[RequestExport] = None   # live generated-ids view
 
 
 @dataclasses.dataclass
@@ -375,6 +377,27 @@ class FakeChunkedEngine:
             if req.cancel.is_set():
                 continue
             i = self._slots.index(None)
+            if req.resume_ids:
+                # Cross-replica import (fleet migration): re-seat from
+                # the portable generated prefix — device cursors resume
+                # at g, and the prefix TEXT is re-emitted for the fleet
+                # relay to suppress (mirror of the batcher's
+                # _admit_resume).
+                g = len(req.resume_ids)
+                slot = _FakeSlot(
+                    req=req, emitted=list(req.resume_ids), dev_idx=g,
+                    dev_ngen=g,
+                    dev_active=(g < req.max_tokens
+                                if self.device_termination else True),
+                    last_tok=req.resume_ids[-1])
+                req.out_queue.put_nowait(
+                    ("token", self._piece(slot.emitted, 0)))
+                if req.export is not None:
+                    req.export.ids = list(slot.emitted)
+                self._slots[i] = slot
+                if g >= req.max_tokens:
+                    self._finish(i, "length")
+                continue
             # Admission "prefill": the stream's first token is emitted
             # immediately (the batcher pipelines it as a "first" entry;
             # collapsing that here keeps the fake synchronous without
@@ -389,6 +412,8 @@ class FakeChunkedEngine:
             if not self.device_termination:
                 slot.dev_active = True
             self._slots[i] = slot
+            if req.export is not None:
+                req.export.ids = list(slot.emitted)
             req.out_queue.put_nowait(("token", self._piece([first], 0)))
             if req.max_tokens <= 1:
                 self._finish(i, "length")
@@ -524,6 +549,8 @@ class FakeChunkedEngine:
             if new_ids:
                 piece = self._piece(new_ids, len(slot.emitted))
                 slot.emitted.extend(new_ids)
+                if slot.req.export is not None:
+                    slot.req.export.ids = list(slot.emitted)
                 slot.req.out_queue.put_nowait(("token", piece))
             if finish is not None:
                 self._finish(i, finish)
@@ -696,9 +723,27 @@ class FakeChunkedEngine:
             engine=self.name,
         )
 
+    async def stream_events(self, prompt: str, *, max_tokens: int = 128,
+                            temperature: float = 0.0,
+                            timeout: Optional[float] = None,
+                            seed: Optional[int] = None,
+                            resume_ids: Optional[List[int]] = None,
+                            export: Optional[RequestExport] = None):
+        """Fleet-facing event stream — the same cross-replica contract
+        the batcher speaks (seed pin, resume import, live export);
+        ``temperature`` is accepted for signature parity and ignored
+        (streams are scripted)."""
+        del temperature
+        async for ev in self._stream_events(
+                prompt, max_tokens=max_tokens, timeout=timeout, seed=seed,
+                resume_ids=resume_ids, export=export):
+            yield ev
+
     async def _stream_events(self, prompt: str, *, max_tokens: int,
                              timeout: Optional[float],
-                             seed: Optional[int] = None):
+                             seed: Optional[int] = None,
+                             resume_ids: Optional[List[int]] = None,
+                             export: Optional[RequestExport] = None):
         if not self._ready:
             raise EngineUnavailable("FakeChunkedEngine not started")
         if seed is None:
@@ -712,6 +757,8 @@ class FakeChunkedEngine:
             cancel=asyncio.Event(),
             stream=list(self.stream_fn(prompt)),
             seed=int(seed),
+            resume_ids=list(resume_ids) if resume_ids else None,
+            export=export,
         )
         self._queue.append(req)
         try:

@@ -1214,6 +1214,24 @@ class JaxEngine:
             if event == "token":
                 yield payload
 
+    async def stream_events(self, prompt: str, *, max_tokens: int = 128,
+                            temperature: float = 0.0,
+                            timeout: Optional[float] = None,
+                            seed: Optional[int] = None,
+                            resume_ids=None, export=None):
+        """Fleet-facing event stream (engine/fleet.py). The
+        single-sequence engine has no cross-replica import/export: a
+        migrated-in request replays from scratch under its pinned seed
+        (same bytes — the fleet relay suppresses the re-emitted prefix)
+        and nothing is exported (migration off this engine also replays
+        from scratch). The batcher overrides this with the full
+        resume/export contract."""
+        del resume_ids, export
+        async for ev in self._stream_events(
+                prompt, max_tokens=max_tokens, temperature=temperature,
+                timeout=timeout, seed=seed):
+            yield ev
+
     async def _stream_events(self, prompt: str, *, max_tokens: int,
                              temperature: float, timeout: Optional[float],
                              seed: Optional[int] = None):

@@ -206,6 +206,24 @@ def scan_chunk_row(tokens_row, already_emitted: int, eos_ids,
 
 
 @dataclass
+class RequestExport:
+    """Live, host-readable view of one request's recoverable state.
+
+    The fleet layer (engine/fleet.py) hands one of these to the engine
+    when it submits a request; the engine's scheduler keeps ``ids``
+    pointed at the generated-so-far token ids (a fresh list is assigned
+    on every update, so a cross-thread reader always sees a consistent
+    snapshot). Together with the per-request sampling seed this is the
+    PORTABLE half of the PR 5 reset-and-replay contract: (prompt,
+    generated-prefix ids, seed) is everything needed to re-splice the
+    request onto a DIFFERENT engine replica and continue the transcript
+    bit-identically — nothing recoverable is welded to one engine's
+    slots."""
+
+    ids: List[int] = field(default_factory=list)
+
+
+@dataclass
 class EngineResult:
     """One completed generation with phase timings."""
 

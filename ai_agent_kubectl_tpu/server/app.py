@@ -47,7 +47,7 @@ from .cache import CachedSingleFlight
 from .executor import CommandExecutor, build_metadata, utcnow_iso
 from .metrics import Metrics, WindowedRate
 from .output_parser import UnsafeCommandError, parse_llm_output
-from .ratelimit import SlidingWindowLimiter, ceil_seconds
+from .ratelimit import SlidingWindowLimiter, ceil_seconds, client_key
 from .sanitize import sanitize_query
 from .schemas import (
     CommandResponse,
@@ -89,15 +89,14 @@ def _span(name: str, **meta):
 
 
 def _client_key(request: web.Request) -> str:
-    """Remote-address key for rate limiting. X-Forwarded-For is honoured
-    only when TRUST_PROXY_HEADERS is set — a direct client could otherwise
-    mint a fresh rate-limit bucket per request by forging the header."""
+    """Remote-address key for rate limiting — the leftmost untrusted
+    X-Forwarded-For hop when TRUST_PROXY(_HEADERS) is set (behind a
+    fronting router tier every request shares one peer IP), the raw peer
+    IP otherwise (ratelimit.client_key)."""
     svc: Service = request.app["service"]
-    if svc.cfg.trust_proxy_headers:
-        fwd = request.headers.get("X-Forwarded-For")
-        if fwd:
-            return fwd.split(",")[0].strip()
-    return request.remote or "unknown"
+    return client_key(request.remote,
+                      request.headers.get("X-Forwarded-For"),
+                      svc.cfg.trust_proxy_headers)
 
 
 def _json_error(status: int, detail: str, headers: Optional[dict] = None) -> web.Response:
@@ -806,6 +805,18 @@ async def handle_health(request: web.Request) -> web.Response:
         last_reset = (time.strftime("%Y-%m-%dT%H:%M:%S",
                                     time.gmtime(sup.last_reset_wall)) + "Z")
         last_cause = sup.last_reset_cause
+    # Fleet deployments (engine/fleet.py): a per-replica section — state,
+    # breaker, occupancy, last reset/cause — plus the fleet rollup
+    # (migration/hedge/drain counters). The cheap health view never calls
+    # stats() (that drains samples owed to the /metrics scrape). The
+    # fleet's most-recent reset also backfills the top-level fields.
+    fleet = None
+    fh = getattr(svc.engine, "fleet_health", None)
+    if callable(fh):
+        fleet = fh() or None
+    if fleet is not None and last_reset is None:
+        last_reset = fleet.get("last_reset")
+        last_cause = fleet.get("last_reset_cause")
     body = HealthResponse(
         status="healthy" if ready and breaker == "closed" else "degraded",
         engine=getattr(svc.engine, "name", "unknown"),
@@ -816,11 +827,18 @@ async def handle_health(request: web.Request) -> web.Response:
         degraded_fallback=svc.fallback is not None,
         last_reset=last_reset,
         last_reset_cause=last_cause,
+        fleet=fleet,
     )
     # The HTTP status tracks engine readiness alone: an open breaker with
     # the engine process alive still serves (fallback and/or cache), and
-    # half-open probes need traffic to ever re-close it.
-    return web.json_response(body.model_dump(), status=200 if ready else 503)
+    # half-open probes need traffic to ever re-close it. A 503 carries
+    # Retry-After priced from the FLEET-wide drain rate (the engine's
+    # aggregate hint) so draining instances tell LBs when to re-probe.
+    if ready:
+        return web.json_response(body.model_dump(), status=200)
+    return web.json_response(
+        body.model_dump(), status=503,
+        headers=_retry_after_header(svc.retry_after_hint()))
 
 
 def _debug_forbidden(request: web.Request) -> Optional[web.Response]:
@@ -963,6 +981,10 @@ async def handle_metrics(request: web.Request) -> web.Response:
         # Containment counters (resets, quarantines, health trips,
         # replayed tokens) — same delta-mirror pattern.
         svc.metrics.observe_containment(stats)
+        # Fleet section (engine/fleet.py): per-replica gauges +
+        # migration/hedge/drain/eject counters.
+        if stats.get("fleet"):
+            svc.metrics.observe_fleet(stats["fleet"])
     # Windowed throughput gauge: the batcher's own scheduler-side window
     # when it reports one (counts every finish, including streams), else
     # the service-side window fed by the response handlers.

@@ -71,6 +71,30 @@ def _build_inner(cfg: ServiceConfig, faults=None) -> Engine:
     raise ValueError(f"Unknown ENGINE: {cfg.engine!r}")
 
 
+def _build_fleet(cfg: ServiceConfig, injector) -> Engine:
+    """FLEET_SIZE > 1: N replicas behind the EngineFleet facade. Each
+    replica gets a replica-scoped VIEW of the one shared fault injector,
+    so ``r0:scheduler:die``-style drills hit exactly the replica they
+    name while counters stay on one ledger."""
+    from ..engine.fleet import EngineFleet
+
+    replicas = []
+    for i in range(cfg.fleet_size):
+        faults = injector.for_replica(i) if injector is not None else None
+        replicas.append(_build_inner(cfg, faults=faults))
+    return EngineFleet(
+        replicas,
+        hedge_ms=cfg.fleet_hedge_ms,
+        affinity=cfg.fleet_affinity,
+        migration_budget=cfg.fleet_migration_budget,
+        rejoin_secs=cfg.fleet_rejoin_secs,
+        drain_secs=cfg.drain_timeout_secs,
+        breaker_threshold=cfg.breaker_threshold,
+        breaker_window_secs=cfg.breaker_window_secs,
+        breaker_recovery_secs=cfg.breaker_recovery_secs,
+    )
+
+
 def build_engine(cfg: ServiceConfig) -> Engine:
     # Parse FAULT_POINTS OUTSIDE the degraded-start net: a typo'd drill
     # spec must refuse to boot, not degrade-start into what looks like a
@@ -88,7 +112,7 @@ def build_engine(cfg: ServiceConfig) -> Engine:
         # boot instead. (FakeChunkedEngine also speaks decode/scheduler,
         # but it is a test harness, not a factory-selectable ENGINE.)
         needs_batcher = [p for p in ("admit", "chunk", "decode", "scheduler")
-                         if injector.has(p)]
+                         if injector.has_any(p)]
         batched = cfg.engine in ("jax", "jax-batched") and (
             cfg.engine == "jax-batched" or cfg.decode_batch_size > 1)
         if needs_batcher and not batched:
@@ -97,8 +121,45 @@ def build_engine(cfg: ServiceConfig) -> Engine:
                 "continuous-batching engine (ENGINE=jax with "
                 f"DECODE_BATCH_SIZE>1); inert under ENGINE={cfg.engine!r}"
             )
+        # r<idx>:-scoped drills only make sense with a fleet that HAS
+        # that replica — a scoped spec that can't fire is a typo, not
+        # chaos (same fail-fast rule as unknown points).
+        scoped = injector.scoped_replicas()
+        if scoped and max(scoped) >= cfg.fleet_size:
+            raise ValueError(
+                f"FAULT_POINTS names replica(s) {sorted(scoped)} but "
+                f"FLEET_SIZE={cfg.fleet_size}; the drill would be inert"
+            )
+        # Generate-path faults wrap the WHOLE service (the ChaosEngine
+        # sits above the fleet facade, replica-blind), so a replica
+        # scope on "generate" could never fire — refuse to boot rather
+        # than run an inert drill.
+        if injector.has_any("generate") and not injector.has("generate"):
+            raise ValueError(
+                "FAULT_POINTS: 'generate' faults cannot be replica-"
+                "scoped (the generate-path wrapper sits above the "
+                "fleet); drop the r<idx>: prefix"
+            )
+    if cfg.fleet_size > 1 and cfg.engine == "openai":
+        # Fail fast outside the degraded-start net: N clients of one
+        # remote endpoint is not a fleet, and silently serving one would
+        # misrepresent the FLEET_SIZE the operator asked for.
+        raise ValueError("FLEET_SIZE > 1 requires a local engine "
+                         "(ENGINE=jax | jax-batched | fake)")
     try:
-        engine = _build_inner(cfg, faults=injector)
+        if cfg.fleet_size > 1:
+            engine = _build_fleet(cfg, injector)
+        else:
+            # The single engine IS replica 0: when a drill carries an
+            # "r0:" scope, hand it the replica-0 view so the drill stays
+            # live at FLEET_SIZE=1 (the raw injector would check it with
+            # replica=None and the scoped fault would be silently
+            # inert). Unscoped drills keep the raw injector — one object
+            # shared with the ChaosEngine wrapper.
+            faults = injector
+            if injector is not None and injector.scoped_replicas():
+                faults = injector.for_replica(0)
+            engine = _build_inner(cfg, faults=faults)
         if injector is not None and injector.has("generate"):
             logger.warning("FAULT_POINTS active on the generate path: %s",
                            injector.describe())

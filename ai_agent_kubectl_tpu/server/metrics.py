@@ -252,6 +252,70 @@ class Metrics:
         self._containment_seen = {"resets": {}, "quarantined": {},
                                   "health_trips": 0, "replayed_tokens": 0}
 
+        # Engine fleet (engine/fleet.py, FLEET_SIZE > 1): replica counts
+        # by lifecycle state, per-replica occupancy/breaker gauges (the
+        # ``replica`` label is the replica index — cardinality bounded
+        # by FLEET_SIZE), and the migration/hedge/drain/eject/rejoin
+        # counters, delta-mirrored from fleet.stats() like the pipeline
+        # and containment totals.
+        self.fleet_replicas = Gauge(
+            "fleet_replicas",
+            "Fleet replicas by lifecycle state",
+            ["state"],  # active | draining | ejected
+            registry=r,
+        )
+        self.fleet_replica_occupancy = Gauge(
+            "fleet_replica_occupancy",
+            "Active decode slots per fleet replica",
+            ["replica"],
+            registry=r,
+        )
+        self.fleet_replica_inflight = Gauge(
+            "fleet_replica_inflight",
+            "Fleet requests currently dispatched to each replica",
+            ["replica"],
+            registry=r,
+        )
+        self.fleet_replica_breaker = Gauge(
+            "fleet_replica_breaker_state",
+            "Per-replica circuit breaker (0=closed, 1=half-open, 2=open)",
+            ["replica"],
+            registry=r,
+        )
+        self.fleet_migrations = Counter(
+            "fleet_migrations_total",
+            "Requests migrated across replicas (crash failover + drains)",
+            registry=r,
+        )
+        self.fleet_migrated_tokens = Counter(
+            "fleet_migrated_tokens_total",
+            "Generated tokens carried across replica migrations",
+            registry=r,
+        )
+        self.fleet_hedges = Counter(
+            "fleet_hedges_total",
+            "Hedged re-dispatches fired past FLEET_HEDGE_MS",
+            registry=r,
+        )
+        self.fleet_drains = Counter(
+            "fleet_drains_total",
+            "Voluntary replica drains started",
+            registry=r,
+        )
+        self.fleet_ejects = Counter(
+            "fleet_ejects_total",
+            "Replicas ejected from rotation (evictions)",
+            registry=r,
+        )
+        self.fleet_rejoins = Counter(
+            "fleet_rejoins_total",
+            "Replicas restarted and returned to rotation",
+            registry=r,
+        )
+        self._fleet_seen = {"migrations": 0, "migrated_tokens": 0,
+                            "hedges": 0, "drains": 0, "ejects": 0,
+                            "rejoins": 0}
+
         # Request-lifecycle phase attribution (obs/trace.py): where a
         # request's wall time went. The ``phase`` label is drawn from the
         # fixed obs.PHASES allowlist — cardinality is bounded by
@@ -310,6 +374,37 @@ class Metrics:
         for key, counter in (("health_trips", self.slot_health_trips),
                              ("replayed_tokens", self.replayed_tokens)):
             total = c.get(key, 0)
+            if total > seen[key]:
+                counter.inc(total - seen[key])
+                seen[key] = total
+
+    #: breaker-state encoding for the per-replica gauge (kept inline —
+    #: importing server.breaker here would be a layering inversion).
+    _BREAKER_CODES = {"closed": 0, "half-open": 1, "open": 2}
+
+    def observe_fleet(self, fleet: dict) -> None:
+        """Mirror the fleet rollup (stats()["fleet"]) into Prometheus at
+        scrape time — gauges set directly, cumulative fleet counters
+        delta-inc'd like the pipeline/containment totals."""
+        for state in ("active", "draining", "ejected"):
+            self.fleet_replicas.labels(state=state).set(
+                fleet.get(state, 0))
+        for rep in fleet.get("replicas", ()):
+            label = str(rep.get("replica", "?"))
+            self.fleet_replica_occupancy.labels(replica=label).set(
+                rep.get("occupancy", 0))
+            self.fleet_replica_inflight.labels(replica=label).set(
+                rep.get("inflight", 0))
+            self.fleet_replica_breaker.labels(replica=label).set(
+                self._BREAKER_CODES.get(rep.get("breaker"), 0))
+        seen = self._fleet_seen
+        for key, counter in (("migrations", self.fleet_migrations),
+                             ("migrated_tokens", self.fleet_migrated_tokens),
+                             ("hedges", self.fleet_hedges),
+                             ("drains", self.fleet_drains),
+                             ("ejects", self.fleet_ejects),
+                             ("rejoins", self.fleet_rejoins)):
+            total = fleet.get(key, 0)
             if total > seen[key]:
                 counter.inc(total - seen[key])
                 seen[key] = total

@@ -14,13 +14,32 @@ from __future__ import annotations
 import math
 import time
 from collections import deque
-from typing import Callable, Deque, Dict, Tuple
+from typing import Callable, Deque, Dict, Optional, Tuple
 
 
 def ceil_seconds(seconds: float) -> int:
     """Whole-second ceiling for Retry-After-style header values (shared by
     the rate limiter and the overload-shed responses in server/app.py)."""
     return math.ceil(seconds) if seconds > 0 else 0
+
+
+def client_key(remote: Optional[str], forwarded_for: Optional[str],
+               trust_proxy: bool) -> str:
+    """Rate-limit bucket key for one request.
+
+    Behind a fronting router tier (the fleet deployment shape) every
+    request arrives from ONE upstream peer IP — keying on it would give
+    the whole user base a single shared quota. With ``trust_proxy``
+    (TRUST_PROXY / TRUST_PROXY_HEADERS) the leftmost ``X-Forwarded-For``
+    hop — the untrusted client as the first proxy saw it — keys the
+    bucket instead. Without it the raw peer IP stays authoritative: a
+    direct client could otherwise mint a fresh quota per request by
+    forging the header."""
+    if trust_proxy and forwarded_for:
+        hops = [h.strip() for h in forwarded_for.split(",") if h.strip()]
+        if hops:
+            return hops[0]
+    return remote or "unknown"
 
 
 class SlidingWindowLimiter:

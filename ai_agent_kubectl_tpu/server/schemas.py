@@ -90,3 +90,8 @@ class HealthResponse(BaseModel):
     # (slot_health | scheduler_error | scheduler_death). None = never.
     last_reset: Optional[str] = None
     last_reset_cause: Optional[str] = None
+    # Fleet deployments (engine/fleet.py, FLEET_SIZE > 1): the rollup —
+    # replica counts by state, migration/hedge/drain/eject/rejoin
+    # totals — plus a ``replicas`` list with each replica's state,
+    # breaker, occupancy, and last reset/cause. None = no fleet layer.
+    fleet: Optional[Dict[str, Any]] = None

@@ -47,6 +47,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import random
+import re
 import threading
 import time
 from typing import AsyncIterator, Dict, List, Optional, Sequence
@@ -89,6 +90,11 @@ class _Fault:
     release_event: threading.Event = dataclasses.field(
         default_factory=threading.Event
     )
+    #: replica scope (engine/fleet.py drills): None fires everywhere; an
+    #: index fires only through that replica's ``for_replica`` view — a
+    #: fleet chaos drill must be able to kill ONE replica's scheduler
+    #: while its siblings stay healthy.
+    replica: Optional[int] = None
 
 
 class FaultInjector:
@@ -121,9 +127,18 @@ class FaultInjector:
             if not item:
                 continue
             parts = item.split(":")
+            # Replica-scoped drills (engine/fleet.py): an ``r<idx>:``
+            # prefix pins the fault to ONE fleet replica, e.g.
+            # "r0:scheduler:die,r0:decode:poison_step" kills replica 0's
+            # scheduler while replica 1 keeps serving.
+            replica = None
+            if parts and re.fullmatch(r"r\d+", parts[0].strip()):
+                replica = int(parts[0].strip()[1:])
+                parts = parts[1:]
             if len(parts) < 2:
                 raise ValueError(
-                    f"FAULT_POINTS entry {item!r} must be point:mode[:arg]"
+                    f"FAULT_POINTS entry {item!r} must be "
+                    f"[r<replica>:]point:mode[:arg]"
                 )
             point, mode = parts[0].strip(), parts[1].strip().lower()
             if point in seen:
@@ -134,12 +149,15 @@ class FaultInjector:
                 )
             seen.add(point)
             arg = float(parts[2]) if len(parts) > 2 else None
-            inj.set(point, mode, arg)
+            inj.set(point, mode, arg, replica=replica)
         return inj
 
-    def set(self, point: str, mode: str, arg: Optional[float] = None) -> None:
+    def set(self, point: str, mode: str, arg: Optional[float] = None,
+            replica: Optional[int] = None) -> None:
         """Arm ``point`` with ``mode``. ``arg`` is the error rate, delay
-        seconds, or max hang seconds depending on the mode."""
+        seconds, or max hang seconds depending on the mode; ``replica``
+        scopes the fault to one fleet replica's ``for_replica`` view
+        (None = fires everywhere, the single-engine behaviour)."""
         if point not in KNOWN_POINTS:
             raise ValueError(
                 f"unknown fault point {point!r}; valid: {KNOWN_POINTS}"
@@ -184,10 +202,38 @@ class FaultInjector:
             # release it so re-arming never orphans a waiter for the old
             # fault's full max_secs.
             old.release_event.set()
-        self._faults[point] = _Fault(mode=mode, arg=float(arg), rate=rate)
+        self._faults[point] = _Fault(mode=mode, arg=float(arg), rate=rate,
+                                     replica=replica)
 
-    def has(self, point: str) -> bool:
+    def has(self, point: str, replica: Optional[int] = None) -> bool:
+        fault = self._faults.get(point)
+        if fault is None:
+            return False
+        return self._in_scope(fault, replica)
+
+    @staticmethod
+    def _in_scope(fault: _Fault, replica: Optional[int]) -> bool:
+        """A replica-scoped fault fires only through that replica's
+        ``for_replica`` view; unscoped faults fire everywhere."""
+        return fault.replica is None or fault.replica == replica
+
+    def has_any(self, point: str) -> bool:
+        """Scope-blind: is ``point`` armed at all (any replica)? The
+        factory's inert-drill refusal needs this — a replica-scoped
+        fault is invisible to ``has()`` without that replica's view."""
         return point in self._faults
+
+    def scoped_replicas(self) -> set:
+        """Replica indices named by r<idx>: scoped faults (empty for a
+        plain single-engine spec)."""
+        return {f.replica for f in self._faults.values()
+                if f.replica is not None}
+
+    def for_replica(self, replica: int) -> "ReplicaFaults":
+        """A view of this injector for ONE fleet replica: same points,
+        same counters, but faults armed with a different replica scope
+        are invisible through it."""
+        return ReplicaFaults(self, replica)
 
     def fired(self, point: str) -> int:
         """How many times ``point`` actually fired (rate misses excluded)."""
@@ -205,19 +251,20 @@ class FaultInjector:
 
     # ------------------------------------------------------------ firing
 
-    def _arm(self, point: str) -> Optional[_Fault]:
+    def _arm(self, point: str,
+             replica: Optional[int] = None) -> Optional[_Fault]:
         fault = self._faults.get(point)
-        if fault is None:
+        if fault is None or not self._in_scope(fault, replica):
             return None
         if fault.rate < 1.0 and self._rng.random() >= fault.rate:
             return None
         self._fired[point] = self._fired.get(point, 0) + 1
         return fault
 
-    def check(self, point: str) -> None:
+    def check(self, point: str, replica: Optional[int] = None) -> None:
         """Synchronous fault check — called from the scheduler thread, so a
         hang here blocks it exactly like a hung device dispatch."""
-        fault = self._arm(point)
+        fault = self._arm(point, replica)
         if fault is None:
             return
         if fault.mode == "error":
@@ -227,9 +274,10 @@ class FaultInjector:
             return
         fault.release_event.wait(timeout=fault.arg)
 
-    async def acheck(self, point: str) -> None:
+    async def acheck(self, point: str,
+                     replica: Optional[int] = None) -> None:
         """Async fault check for coroutine call sites (ChaosEngine)."""
-        fault = self._arm(point)
+        fault = self._arm(point, replica)
         if fault is None:
             return
         if fault.mode == "error":
@@ -255,13 +303,15 @@ class FaultInjector:
         return live[:1]
 
     def decode_nan_slots(
-            self, prompts: Sequence[Optional[str]]) -> List[int]:
+            self, prompts: Sequence[Optional[str]],
+            replica: Optional[int] = None) -> List[int]:
         """Slots whose logits this chunk dispatch should corrupt to NaN
         (``decode:nan:<p>``). ``prompts[i]`` is slot i's prompt text or
         None for a free slot. Empty list = no corruption this dispatch
         (not armed, rate miss, or no matching slot)."""
         fault = self._faults.get("decode")
-        if fault is None or fault.mode != "nan":
+        if (fault is None or fault.mode != "nan"
+                or not self._in_scope(fault, replica)):
             return []
         targets = self._targets(prompts)
         if not targets:
@@ -271,7 +321,8 @@ class FaultInjector:
         self._fired["decode"] = self._fired.get("decode", 0) + 1
         return targets
 
-    def poison_fetch(self, prompts: Sequence[Optional[str]]) -> None:
+    def poison_fetch(self, prompts: Sequence[Optional[str]],
+                     replica: Optional[int] = None) -> None:
         """``decode:poison_step`` — raise from the chunk FETCH, the
         step-wide poison that names no slot (the bisect pass's target
         scenario). ``prompts`` is the fetched chunk's snapshot; with a
@@ -280,6 +331,8 @@ class FaultInjector:
         fault = self._faults.get("decode")
         if fault is None or fault.mode != "poison_step":
             return
+        if not self._in_scope(fault, replica):
+            return
         if not self._targets(prompts):
             return
         if fault.rate < 1.0 and self._rng.random() >= fault.rate:
@@ -287,12 +340,14 @@ class FaultInjector:
         self._fired["decode"] = self._fired.get("decode", 0) + 1
         raise InjectedFault("injected poisoned step at chunk fetch")
 
-    def check_scheduler_die(self) -> None:
+    def check_scheduler_die(self, replica: Optional[int] = None) -> None:
         """``scheduler:die`` — one-shot: raises ``SchedulerKilled`` (a
         BaseException) so the scheduler loop genuinely dies; disarms
         itself so the supervisor's restarted loop survives."""
         fault = self._faults.get("scheduler")
         if fault is None or fault.mode != "die":
+            return
+        if not self._in_scope(fault, replica):
             return
         del self._faults["scheduler"]
         self._fired["scheduler"] = self._fired.get("scheduler", 0) + 1
@@ -300,11 +355,63 @@ class FaultInjector:
 
     def describe(self) -> str:
         return ",".join(
-            f"{p}:{f.mode}" + (f":{f.rate}"
-                               if f.mode in ("error", "nan", "poison_step")
-                               and f.rate < 1.0 else "")
+            (f"r{f.replica}:" if f.replica is not None else "")
+            + f"{p}:{f.mode}"
+            + (f":{f.rate}"
+               if f.mode in ("error", "nan", "poison_step")
+               and f.rate < 1.0 else "")
             for p, f in self._faults.items()
         ) or "none"
+
+
+class ReplicaFaults:
+    """Per-replica view of a shared :class:`FaultInjector` — handed to
+    each fleet replica's engine so replica-scoped drills (``r0:...``)
+    fire only inside the replica they name, while unscoped faults and
+    all counters/targeting stay on the ONE underlying injector (a drill
+    still has one ``fired()`` ledger and one ``target_substr``)."""
+
+    def __init__(self, inner: FaultInjector, replica: int):
+        self.inner = inner
+        self.replica = replica
+
+    @property
+    def target_substr(self) -> Optional[str]:
+        return self.inner.target_substr
+
+    @target_substr.setter
+    def target_substr(self, value: Optional[str]) -> None:
+        self.inner.target_substr = value
+
+    def has(self, point: str) -> bool:
+        return self.inner.has(point, replica=self.replica)
+
+    def fired(self, point: str) -> int:
+        return self.inner.fired(point)
+
+    def release(self, point: str) -> None:
+        self.inner.release(point)
+
+    def clear(self) -> None:
+        self.inner.clear()
+
+    def check(self, point: str) -> None:
+        self.inner.check(point, replica=self.replica)
+
+    async def acheck(self, point: str) -> None:
+        await self.inner.acheck(point, replica=self.replica)
+
+    def decode_nan_slots(self, prompts) -> List[int]:
+        return self.inner.decode_nan_slots(prompts, replica=self.replica)
+
+    def poison_fetch(self, prompts) -> None:
+        self.inner.poison_fetch(prompts, replica=self.replica)
+
+    def check_scheduler_die(self) -> None:
+        self.inner.check_scheduler_die(replica=self.replica)
+
+    def describe(self) -> str:
+        return f"replica {self.replica} view of [{self.inner.describe()}]"
 
 
 class ChaosEngine:
@@ -338,6 +445,12 @@ class ChaosEngine:
     def retry_after_hint(self) -> float:
         fn = getattr(self.inner, "retry_after_hint", None)
         return float(fn()) if callable(fn) else 1.0
+
+    def fleet_health(self) -> dict:
+        """Forward the per-replica /health view when the wrapped engine
+        is an EngineFleet (generate-point drills wrap the whole fleet)."""
+        fn = getattr(self.inner, "fleet_health", None)
+        return fn() if callable(fn) else {}
 
     def set_reset_listener(self, fn) -> None:
         """Forward the containment reset→breaker hookup to the wrapped

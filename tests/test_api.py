@@ -322,6 +322,35 @@ async def test_xff_not_trusted_by_default():
         await client.close()
 
 
+async def test_xff_trusted_behind_proxy_keys_per_client():
+    """TRUST_PROXY mode (behind a fronting router tier every request
+    arrives from one upstream peer IP): the leftmost X-Forwarded-For hop
+    keys the rate-limit bucket, so distinct clients get distinct quotas
+    while one client's second request still 429s."""
+    client, _ = await make_client(
+        make_cfg(rate_limit="1/minute", trust_proxy_headers=True))
+    try:
+        r1 = await client.post(
+            "/kubectl-command", json={"query": "list pods"},
+            headers={"X-Forwarded-For": "1.1.1.1, 10.0.0.1"},
+        )
+        assert r1.status == 200
+        # A DIFFERENT client through the same proxy: its own bucket.
+        r2 = await client.post(
+            "/kubectl-command", json={"query": "list pods"},
+            headers={"X-Forwarded-For": "2.2.2.2, 10.0.0.1"},
+        )
+        assert r2.status == 200
+        # The first client again: over ITS quota.
+        r3 = await client.post(
+            "/kubectl-command", json={"query": "list pods"},
+            headers={"X-Forwarded-For": "1.1.1.1, 10.0.0.1"},
+        )
+        assert r3.status == 429
+    finally:
+        await client.close()
+
+
 async def test_stream_uses_and_fills_cache():
     client, engine = await make_client(make_cfg())
     try:

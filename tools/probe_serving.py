@@ -131,6 +131,7 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
     if consumed:
         log(f"  wasted steps / consumed chunk {wasted / consumed:>8.2f}")
     print_containment_summary(gauges)
+    print_fleet_summary(gauges)
 
 
 def _sum_labelled(gauges: Dict[str, float], name: str) -> Dict[str, float]:
@@ -165,6 +166,42 @@ def print_containment_summary(gauges: Dict[str, float]) -> None:
     log(f"  slot health trips total     {trips or 0:>8.0f}")
     log(f"  replayed tokens total       "
         f"{gauges.get('replayed_tokens_total', 0.0):>8.0f}")
+
+
+def print_fleet_summary(gauges: Dict[str, float]) -> None:
+    """Engine-fleet counters (FLEET_SIZE > 1) from the same /metrics
+    scrape: per-replica occupancy and breaker state, migration/eviction
+    totals, and the hedge rate (hedges per consumed request-equivalent
+    — how often the latency budget forced a second dispatch)."""
+    states = _sum_labelled(gauges, "fleet_replicas")
+    if not states:
+        return      # single-engine deployment (no fleet layer)
+    occ = _sum_labelled(gauges, "fleet_replica_occupancy")
+    inflight = _sum_labelled(gauges, "fleet_replica_inflight")
+    brk = _sum_labelled(gauges, "fleet_replica_breaker_state")
+    brk_names = {0: "closed", 1: "half-open", 2: "open"}
+    log("probe[fleet]: engine fleet")
+    log("  replicas by state           "
+        + ", ".join(f"{k.split('=')[-1].strip(chr(34))}={v:.0f}"
+                    for k, v in sorted(states.items())))
+    for key in sorted(occ):
+        rep = key.split("=")[-1].strip('"')
+        b = brk.get(key, 0.0)
+        log(f"  replica {rep}: occupancy={occ[key]:.0f} "
+            f"inflight={inflight.get(key, 0.0):.0f} "
+            f"breaker={brk_names.get(int(b), '?')}")
+    migrations = gauges.get("fleet_migrations_total", 0.0)
+    hedges = gauges.get("fleet_hedges_total", 0.0)
+    log(f"  migrations total            {migrations:>8.0f}"
+        f"  ({gauges.get('fleet_migrated_tokens_total', 0.0):.0f} tokens "
+        "carried)")
+    log(f"  evictions (ejects) total    "
+        f"{gauges.get('fleet_ejects_total', 0.0):>8.0f}"
+        f"  (drains={gauges.get('fleet_drains_total', 0.0):.0f}, "
+        f"rejoins={gauges.get('fleet_rejoins_total', 0.0):.0f})")
+    consumed = gauges.get('decode_chunks_total{event="consume"}', 0.0)
+    rate = f"  ({hedges / consumed:.4f}/chunk)" if consumed else ""
+    log(f"  hedged dispatches total     {hedges:>8.0f}{rate}")
 
 
 async def http_probe(args) -> None:
