@@ -235,6 +235,39 @@ class ServiceConfig:
     # only (drain/eject leaves the replica down until an operator acts).
     fleet_rejoin_secs: float = 0.0          # FLEET_REJOIN_SECS
 
+    # --- QoS ring (ISSUE 7; engine/qos.py) ---
+    # Tenant tiers: "tenantKey:lane,..." mapping a tenant key (the API
+    # key a client presents, else its client IP) to the HIGHEST lane it
+    # may claim (interactive | batch | background). An X-Priority header
+    # can lower a request below its tier but never raise it above.
+    # Unlisted tenants default to QOS_DEFAULT_LANE.
+    tenant_tiers: str = ""                  # TENANT_TIERS
+    # Lane a request runs in when neither TENANT_TIERS nor X-Priority
+    # names one. "interactive" keeps single-tenant deployments exactly
+    # as fast as before the QoS ring existed.
+    qos_default_lane: str = "interactive"   # QOS_DEFAULT_LANE
+    # WDRR lane weights: one saturated scheduling round serves this many
+    # requests per lane ("interactive:8,batch:4,background:1").
+    lane_weights: str = ""                  # LANE_WEIGHTS
+    # Per-tenant in-queue cap: a tenant with this many requests already
+    # waiting is shed with a fast 429 (the flooding tenant's problem,
+    # not everyone's 503). 0 = no cap below MAX_QUEUE_DEPTH.
+    tenant_max_queue: int = 0               # TENANT_MAX_QUEUE
+    # Preemptive decode: once a higher-lane request has queue-waited
+    # this long with every slot busy, the scheduler exports the
+    # cheapest lower-lane victim (PR 6 RequestExport path), frees its
+    # slot, and re-enqueues it at the head of its tenant queue for a
+    # bit-identical seeded replay. 0 disables preemption.
+    preempt_wait_ms: float = 500.0          # PREEMPT_WAIT_MS
+    # How many times one request may be preempted before it becomes
+    # un-preemptable (victim selection skips it) — bounds livelock.
+    preempt_budget: int = 2                 # PREEMPT_BUDGET
+    # Interactive queue-wait SLO driving the AIMD brownout controller:
+    # when interactive p95 queue wait breaches this, background's slot
+    # share halves first (then batch); recovery is additive, batch
+    # first. 0 disables the controller.
+    slo_interactive_ms: float = 2000.0      # SLO_INTERACTIVE_MS
+
     # --- overload protection / failure containment ---
     # Bounded admission: the batcher sheds work with a fast 503 +
     # Retry-After once this many requests are queued for a decode slot,
@@ -317,6 +350,28 @@ class ServiceConfig:
         count, window = parse_rate_limit(self.rate_limit)
         object.__setattr__(self, "rate_limit_count", count)
         object.__setattr__(self, "rate_limit_window", window)
+        # Validate the QoS specs at boot — a typo'd tier or weight must
+        # refuse to start, not silently skew the scheduler. (Lazy import:
+        # config is the base layer; engine.qos only pulls stdlib +
+        # engine.protocol.)
+        self.tenant_tier_map
+        self.lane_weight_map
+
+    @property
+    def tenant_tier_map(self) -> dict:
+        from .engine.qos import LANES, parse_tenant_tiers
+
+        if self.qos_default_lane not in LANES:
+            raise ValueError(
+                f"QOS_DEFAULT_LANE must be one of {LANES}, "
+                f"got {self.qos_default_lane!r}")
+        return parse_tenant_tiers(self.tenant_tiers)
+
+    @property
+    def lane_weight_map(self) -> dict:
+        from .engine.qos import parse_lane_weights
+
+        return parse_lane_weights(self.lane_weights)
 
     @property
     def auth_enabled(self) -> bool:
@@ -377,6 +432,15 @@ class ServiceConfig:
             fleet_affinity=_env_bool("FLEET_AFFINITY", True),
             fleet_migration_budget=_env_int("FLEET_MIGRATION_BUDGET", 3),
             fleet_rejoin_secs=_env_float("FLEET_REJOIN_SECS", 0.0),
+            tenant_tiers=_env_str("TENANT_TIERS", "") or "",
+            qos_default_lane=(
+                _env_str("QOS_DEFAULT_LANE", "interactive")
+                or "interactive").lower(),
+            lane_weights=_env_str("LANE_WEIGHTS", "") or "",
+            tenant_max_queue=_env_int("TENANT_MAX_QUEUE", 0),
+            preempt_wait_ms=_env_float("PREEMPT_WAIT_MS", 500.0),
+            preempt_budget=_env_int("PREEMPT_BUDGET", 2),
+            slo_interactive_ms=_env_float("SLO_INTERACTIVE_MS", 2000.0),
             max_queue_depth=_env_int("MAX_QUEUE_DEPTH", 64),
             max_inflight_requests=_env_int("MAX_INFLIGHT_REQUESTS", 256),
             degraded_fallback=_env_bool("DEGRADED_FALLBACK", False),
@@ -410,4 +474,8 @@ class ServiceConfig:
         for secret in ("api_auth_key", "openai_api_key", "debug_token"):
             if d.get(secret):
                 d[secret] = "***"
+        if d.get("tenant_tiers"):
+            # Tenant keys are API keys; log only the lane assignments.
+            d["tenant_tiers"] = ",".join(
+                f"***:{lane}" for lane in self.tenant_tier_map.values())
         return d

@@ -56,9 +56,11 @@ from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
 from .jax_engine import JaxEngine
 from .protocol import (HEALTH_NONFINITE, HEALTH_TOKEN_RANGE, EngineOverloaded,
                        EngineResult, EngineUnavailable, GenerationTimeout,
-                       RequestExport, RequestQuarantined, consume_chunk_row,
-                       describe_health, pack_chunk, scan_chunk_row,
-                       unpack_chunk)
+                       RequestExport, RequestQuarantined, TenantOverloaded,
+                       consume_chunk_row, describe_health, pack_chunk,
+                       scan_chunk_row, unpack_chunk)
+from .qos import (ANON_TENANT, LANE_BACKGROUND, LANE_BATCH, LANE_INTERACTIVE,
+                  LANES, BrownoutController, QoSQueue, current_qos, lane_rank)
 from .sampling import eos_mask, sample_tokens_seeded
 from .tokenizer import StreamDecoder
 
@@ -241,6 +243,28 @@ class _Request:
     # _admit_resume pass must not emit the prefix a second time (the
     # fleet's suppression window was already consumed by the first).
     resume_emitted: bool = False
+    # QoS ring (ISSUE 7): the fair-share tenant key (API key else client
+    # IP) and priority lane this request runs in, read off the
+    # qos-context contextvar at submit time. The QoSQueue schedules by
+    # these; defaults keep direct engine calls on the pre-QoS behaviour
+    # (one interactive anon bucket).
+    tenant: str = ANON_TENANT
+    lane: str = LANE_INTERACTIVE
+    # Stamped by the QoSQueue at every (re-)enqueue; preemption and the
+    # starved-lane trigger judge waits against THIS, not t_submit, so a
+    # just-preempted victim can't instantly read as starved.
+    t_enqueue: float = 0.0
+    # Preemptive decode (the PR 6 export/replay path turned inward): how
+    # many times this request has been preempted out of a slot
+    # (PREEMPT_BUDGET bounds it), when the current preemption started
+    # (monotonic; the wall from here to re-admission is credited back to
+    # the deadline — preempted time is excluded from the victim's
+    # clock), and how many chars of the resume prefix's TEXT the client
+    # already received (the _admit_resume emission skips exactly that
+    # many, the engine-side analog of the fleet relay's suppression).
+    preempt_count: int = 0
+    preempt_t0: Optional[float] = None
+    resume_skip: int = 0
 
 
 @dataclasses.dataclass
@@ -287,6 +311,11 @@ class BatchedJaxEngine(JaxEngine):
                  slot_health_check: bool = True,
                  quarantine_retry_budget: int = 1,
                  reset_max_per_min: int = 12,
+                 lane_weights: Optional[dict] = None,
+                 tenant_max_queue: int = 0,
+                 preempt_wait_ms: float = 500.0,
+                 preempt_budget: int = 2,
+                 slo_interactive_ms: float = 0.0,
                  faults=None,
                  **kwargs):
         super().__init__(*args, **kwargs)
@@ -350,6 +379,22 @@ class BatchedJaxEngine(JaxEngine):
         # queue depth raise EngineOverloaded at submit time instead of
         # waiting llm_timeout for a slot that cannot come. 0 = unbounded.
         self.max_queue_depth = max(0, max_queue_depth)
+        # QoS ring (ISSUE 7): preemptive-decode policy knobs. The queue
+        # itself (fair-share WDRR + tenant caps + scan-time expiry) is
+        # built below as self._admissions; the brownout controller trims
+        # effective batch/background slot shares when interactive queue
+        # wait breaches its SLO.
+        self.preempt_wait_ms = max(0.0, preempt_wait_ms)
+        self.preempt_budget = max(0, preempt_budget)
+        self._brownout = BrownoutController(slo_interactive_ms)
+        self._preemptions = 0          # cumulative preempt-and-replay count
+        self._preempted_tokens = 0     # generated tokens carried across them
+        self._preempt_times: collections.deque = collections.deque(maxlen=512)
+        self._preempt_for_lane: Optional[str] = None
+        # Per-lane completion timestamps so Retry-After on a shed is
+        # priced from the SHED LANE's own drain rate (a background shed
+        # must not quote the interactive lane's brisk drain).
+        self._lane_finish: dict = {}
         #: testing/faults.py injector (admit / chunk / decode / scheduler
         #: points); None in normal serving.
         self.faults = faults
@@ -396,7 +441,17 @@ class BatchedJaxEngine(JaxEngine):
         self._fetch_samples: collections.deque = collections.deque(maxlen=4096)
         self._last_n_alive = 0
         self._chunk_log: collections.deque = collections.deque(maxlen=512)
-        self._admissions: _queue.Queue = _queue.Queue()
+        # Fair-share admission (the ISSUE 7 tentpole): weighted
+        # deficit-round-robin over per-tenant sub-queues replaces the
+        # FIFO queue.Queue — same put/get/qsize surface, plus per-tenant
+        # caps, flood-preferring displacement, and scan-time expiry
+        # (an expired request stops occupying MAX_QUEUE_DEPTH the moment
+        # it is dead, counted as queue_expired instead of served).
+        self._admissions: QoSQueue = QoSQueue(
+            max_depth=self.max_queue_depth,
+            tenant_cap=max(0, tenant_max_queue),
+            weights=lane_weights,
+            on_expire=self._expire_queued)
         self._worker: Optional[threading.Thread] = None
         self._running = False
         self._group_admitted = 0   # batched group admissions served
@@ -465,6 +520,11 @@ class BatchedJaxEngine(JaxEngine):
             slot_health_check=cfg.slot_health_check,
             quarantine_retry_budget=cfg.quarantine_retry_budget,
             reset_max_per_min=cfg.engine_reset_max_per_min,
+            lane_weights=cfg.lane_weight_map,
+            tenant_max_queue=cfg.tenant_max_queue,
+            preempt_wait_ms=cfg.preempt_wait_ms,
+            preempt_budget=cfg.preempt_budget,
+            slo_interactive_ms=cfg.slo_interactive_ms,
             faults=faults,
         )
 
@@ -1104,6 +1164,19 @@ class BatchedJaxEngine(JaxEngine):
             "containment": dict(self.supervisor.stats(),
                                 parked=len(self._parked),
                                 slot_health_check=self.slot_health_check),
+            # QoS ring (ISSUE 7): per-lane queue depth + occupancy,
+            # expiry/displacement/preemption totals, brownout state —
+            # delta-mirrored into Prometheus at scrape time
+            # (Metrics.observe_qos) and summarized in /health.
+            "qos": dict(self._admissions.stats(),
+                        lane_occupancy=self.lane_occupancy(),
+                        preemptions=self._preemptions,
+                        preempted_tokens=self._preempted_tokens,
+                        brownout_level=self._brownout.level,
+                        brownout_transitions=self._brownout.transitions,
+                        lane_shares={
+                            k: round(v, 4)
+                            for k, v in self._brownout.shares.items()}),
         }
 
     #: finish timestamps older than this don't feed the drain-rate
@@ -1114,14 +1187,28 @@ class BatchedJaxEngine(JaxEngine):
     #: averaging window for the stats() tokens_per_sec_window rate.
     TOKEN_RATE_WINDOW_SECS = 60.0
 
-    def retry_after_hint(self, extra_depth: int = 0) -> float:
+    def retry_after_hint(self, extra_depth: int = 0,
+                         lane: Optional[str] = None) -> float:
         """Seconds until queued work plausibly drains, from the live
         completion rate over recent finishes (last ≤64, within the
         freshness horizon) — the Retry-After a shed response carries.
-        Falls back to 5 s with no recent drain history (cold or
+        With ``lane`` set the estimate is priced from THAT lane's own
+        queue depth and drain rate (a background shed must not quote
+        the interactive lane's brisk drain); it falls back to the
+        engine-wide estimate when the lane has no drain history. Falls
+        back to 5 s with no recent drain history at all (cold or
         just-woken engine), clamped to [1, 60]."""
-        depth = self._admissions.qsize() + extra_depth
         horizon = time.monotonic() - self.DRAIN_RATE_HORIZON_SECS
+        if lane is not None:
+            depth = self._admissions.lane_depths().get(lane, 0) + extra_depth
+            ts = [t for t in list(self._lane_finish.get(lane, ()))
+                  if t >= horizon]
+            if len(ts) >= 2 and ts[-1] > ts[0]:
+                rate = (len(ts) - 1) / (ts[-1] - ts[0])
+                if rate > 0:
+                    return min(max(depth / rate, 1.0), 60.0)
+            return self.retry_after_hint(extra_depth)
+        depth = self._admissions.qsize() + extra_depth
         ts = [t for t in list(self._finish_times) if t >= horizon]
         if len(ts) >= 2 and ts[-1] > ts[0]:
             rate = (len(ts) - 1) / (ts[-1] - ts[0])
@@ -1173,6 +1260,13 @@ class BatchedJaxEngine(JaxEngine):
                         and all(s is None for s in self._slots)):
                     self._unpark_parked()
                     continue
+                # QoS ring: AIMD brownout evaluation (time-gated, cheap)
+                # and preemptive decode — a higher-lane request starved
+                # past PREEMPT_WAIT_MS with every slot busy exports the
+                # cheapest lower-lane victim, whose freed slot the
+                # _admit_pending call right below hands to that lane.
+                self._brownout.maybe_eval()
+                self._maybe_preempt()
                 self._admit_pending()
                 self._sweep_finishes()
                 n_active = sum(
@@ -1559,7 +1653,9 @@ class BatchedJaxEngine(JaxEngine):
             slotted = {id(s.req) for s in survivors}
             for req in self._admitting_reqs:
                 if id(req) not in slotted:
-                    self._admissions.put(req)
+                    # Head re-entry, never put(): an already-admitted
+                    # request must not be shed by caps on its way back.
+                    self._admissions.requeue_head(req)
             self._admitting_reqs.clear()
             self._slots = [None] * self.batch_size
             self._inflight.clear()
@@ -1648,6 +1744,176 @@ class BatchedJaxEngine(JaxEngine):
                     self.admit_scratch_mb, depth,
                     self._admit_kpad_caps[depth], row / 1e6)
 
+    # --------------------------------------------- QoS ring (ISSUE 7)
+
+    def lane_occupancy(self) -> dict:
+        """Slots held per lane (racy read — routing/brownout hint, not
+        an invariant). The fleet's lane-aware router reads this to know
+        that a replica full of background work is still routable for
+        interactive traffic."""
+        counts = {lane: 0 for lane in LANES}
+        for s in list(getattr(self, "_slots", None) or []):
+            if s is not None:
+                lane = getattr(s.req, "lane", LANE_INTERACTIVE)
+                counts[lane if lane in LANES else LANE_INTERACTIVE] += 1
+        return counts
+
+    def _capped_lanes(self, counts: dict) -> tuple:
+        """Lanes at their brownout-trimmed slot cap: admission skips
+        them (they stay queued) until interactive queue wait recovers.
+        Caps floor at one slot, so brownout never starves a lane."""
+        capped = []
+        for lane in (LANE_BACKGROUND, LANE_BATCH):
+            cap = self._brownout.lane_cap(lane, self.batch_size)
+            if cap < self.batch_size and counts.get(lane, 0) >= cap:
+                capped.append(lane)
+        return tuple(capped)
+
+    def _expire_queued(self, req: _Request) -> None:
+        """QoSQueue scan-time expiry callback: a queued request whose
+        deadline passed is failed NOW and stops occupying
+        MAX_QUEUE_DEPTH (counted as queue_expired, not served)."""
+        if req.trace is not None:
+            req.trace.event("qos: deadline expired while queued — purged "
+                            "at queue scan")
+        self._emit(req, "error",
+                   GenerationTimeout("deadline expired while queued"))
+
+    def _credit_preempt_wait(self, req: _Request) -> None:
+        """Exclude preempted-out wall time from the victim's deadline:
+        the clock stopped at preemption and restarts at re-admission."""
+        t0 = req.preempt_t0
+        if t0 is None:
+            return
+        req.preempt_t0 = None
+        paused = time.monotonic() - t0
+        if req.deadline is not None:
+            req.deadline += paused
+        if req.trace is not None:
+            req.trace.event(f"qos: resuming after {paused * 1000.0:.0f}ms "
+                            f"preempted (deadline credited)")
+
+    def _maybe_preempt(self) -> bool:
+        """Preemptive decode: when a higher-lane request has queue-waited
+        past PREEMPT_WAIT_MS and every slot is busy, export the cheapest
+        strictly-lower-lane victim (fewest generated tokens, lowest
+        lane) through the PR 6 RequestExport path and re-enqueue it at
+        the head of its tenant queue; _admit_pending hands the freed
+        slot to the starved lane. Victims over PREEMPT_BUDGET are never
+        picked again — budget exhaustion leaves them running."""
+        if self.preempt_wait_ms <= 0 or self._parked:
+            return False
+        if any(s is None for s in self._slots):
+            return False
+        now = time.monotonic()
+        # A brownout-capped lane can't use a freed slot (admission would
+        # exclude it) — preempting for it would just churn the victim.
+        lane = self._admissions.starved_lane(
+            now, self.preempt_wait_ms / 1000.0,
+            exclude=self._capped_lanes(self.lane_occupancy()))
+        if lane is None:
+            return False
+        rank = lane_rank(lane)
+        victims = [
+            (i, s) for i, s in enumerate(self._slots)
+            if s is not None and not s.exhausted
+            and lane_rank(getattr(s.req, "lane", LANE_INTERACTIVE)) < rank
+            and s.req.preempt_count < self.preempt_budget
+        ]
+        if not victims:
+            return False
+        idx, _ = min(victims,
+                     key=lambda t: (lane_rank(t[1].req.lane),
+                                    len(t[1].detok.ids)))
+        self._preempt_slot(idx, lane)
+        self._preempt_for_lane = lane
+        return True
+
+    def _preempt_slot(self, idx: int, for_lane: str) -> None:
+        """Export one running request and free its slot — the PR 5/6
+        replay contract turned inward: (prompt, generated ids, seed) is
+        the portable state, so the later _admit_resume re-splice
+        continues the transcript bit-identically. In-flight chunks for
+        this slot are discarded by snapshot mismatch exactly like a
+        cancel; their already-executed steps are billed as waste."""
+        slot = self._slots[idx]
+        self._slots[idx] = None
+        req = slot.req
+        req.preempt_count += 1
+        req.preempt_t0 = time.monotonic()
+        ids = list(slot.detok.ids)
+        req.resume_ids = ids or None
+        # The client already holds detok.text; the resume emission skips
+        # exactly that many chars (UTF-8 hold-back means text can trail
+        # ids — same suppression the fleet relay does by length).
+        req.resume_skip = len(slot.detok.text)
+        req.resume_emitted = False
+        if req.export is not None:
+            req.export.ids = list(ids)
+        if (self.device_termination and slot.decode_chunks_inflight > 0):
+            remaining = max(0, req.max_tokens - len(ids))
+            self._wasted_steps += min(
+                slot.decode_chunks_inflight * self.chunk_len, remaining)
+        self._preemptions += 1
+        self._preempted_tokens += len(ids)
+        self._preempt_times.append(req.preempt_t0)
+        if req.trace is not None:
+            req.trace.event(
+                f"qos: preempted out of slot {idx} after {len(ids)} tokens "
+                f"(lane {req.lane} yields to starved lane {for_lane}; "
+                f"preemption {req.preempt_count}/{self.preempt_budget}) — "
+                f"exported for seeded replay")
+        self._admissions.requeue_head(req)
+
+    def _inject_flood(self, n: int, loop) -> None:
+        """tenant:flood:<n> drill (testing/faults.py): enqueue a burst
+        of real decode work under one synthetic background tenant so
+        fairness and preemption are exercisable without a load
+        generator. Bursts past the queue's own caps are simply dropped —
+        the drill must not wedge the queue it is stressing."""
+        from ..testing.faults import FLOOD_LANE, FLOOD_TENANT
+
+        now = time.monotonic()
+        max_toks = max(1, min(32, self.max_seq_len // 2))
+        for i in range(n):
+            prompt = f"tenant flood drill {i}"
+            req = _Request(
+                prompt_ids=self.tokenizer.encode(prompt),
+                max_tokens=max_toks,
+                temperature=0.0,
+                deadline=now + 30.0,
+                loop=loop,
+                out_queue=asyncio.Queue(),
+                cancel=threading.Event(),
+                t_submit=now,
+                seed=i,
+                prompt=prompt,
+                tenant=FLOOD_TENANT,
+                lane=FLOOD_LANE,
+            )
+            try:
+                self._admissions.put(req)
+            except EngineOverloaded:
+                break
+
+    def qos_health(self) -> dict:
+        """Cheap QoS view for /health (never calls stats() — that drains
+        samples owed to the /metrics scrape): per-lane queue depth, the
+        active brownout level/shares, and preemptions in the last
+        minute."""
+        now = time.monotonic()
+        return {
+            "lanes": self._admissions.lane_depths(),
+            "brownout_level": self._brownout.level,
+            "lane_shares": {k: round(v, 4)
+                            for k, v in self._brownout.shares.items()},
+            "preemptions_total": self._preemptions,
+            "preemptions_last_60s": sum(
+                1 for t in list(self._preempt_times) if t >= now - 60.0),
+            "queue_expired_total": self._admissions.expired_total,
+            "queue_displaced_total": self._admissions.displaced_total,
+        }
+
     def _admit_pending(self) -> None:
         """Admit every queued request that fits a free slot. Requests on
         the prefix-cache suffix path with the same (bucket, kv span) are
@@ -1664,12 +1930,27 @@ class BatchedJaxEngine(JaxEngine):
             # never dropped); probation lasts at most a few chunks.
             return
         free = sum(s is None for s in self._slots)
+        # QoS: lanes at their browned-out slot cap stay queued (their
+        # requests are skipped, not shed); right after a preemption the
+        # first pop is pinned to the starved lane so the freed slot goes
+        # to the waiter the preemption was FOR, not to whatever lane the
+        # WDRR round happened to be serving.
+        counts = self.lane_occupancy()
+        prefer, self._preempt_for_lane = self._preempt_for_lane, None
         pending = []
         while len(pending) < free:
             try:
-                pending.append(self._admissions.get_nowait())
+                req = self._admissions.get_nowait(
+                    exclude_lanes=self._capped_lanes(counts),
+                    min_lane=prefer)
             except _queue.Empty:
-                break
+                if prefer is None:
+                    break
+                prefer = None   # starved waiter vanished (cancel/expiry)
+                continue
+            prefer = None
+            counts[req.lane if req.lane in LANES else LANE_INTERACTIVE] += 1
+            pending.append(req)
         if not pending:
             return
         # Popped-but-not-yet-slotted requests are invisible to both the
@@ -1688,6 +1969,10 @@ class BatchedJaxEngine(JaxEngine):
         # error event — an exception mid-burst (e.g. OOM allocating the
         # group scratch) may not silently drop the rest of the burst, or
         # their generate() calls would block forever.
+        for req in pending:
+            # Preempted victims resume with their paused wall excluded
+            # from the deadline, BEFORE any deadline check can see it.
+            self._credit_preempt_wait(req)
         def guarded(admit, reqs):
             # Tick the watchdog per admission: a lazily-compiled admission
             # shape can legitimately block for tens of seconds and must
@@ -1897,6 +2182,9 @@ class BatchedJaxEngine(JaxEngine):
                             sbucket: int, kv_limit: int) -> None:
         prefix = self._prefix
         t_adm = time.monotonic()
+        for req in live:
+            self._brownout.note_queue_wait(
+                req.lane, (t_adm - req.t_submit) * 1000.0, now=t_adm)
 
         # Suffix-depth scratch: kv_limit positions hold everything a
         # suffix admission writes (prefix.n + sbucket, tile-rounded); the
@@ -1990,6 +2278,8 @@ class BatchedJaxEngine(JaxEngine):
             return
         slot_idx = self._slots.index(None)
         t_adm = time.monotonic()
+        self._brownout.note_queue_wait(
+            req.lane, (t_adm - req.t_submit) * 1000.0, now=t_adm)
 
         last_logits, scratch, n_prompt, prefix_hit = self._prefill_prompt(
             req.prompt_ids, req.max_tokens
@@ -2057,7 +2347,15 @@ class BatchedJaxEngine(JaxEngine):
         piece = detok.push(*req.resume_ids)
         if req.resume_emitted:
             piece = None          # requeued after a mid-admission death
+        elif req.resume_skip and piece is not None:
+            # Preemption resume (same engine, no fleet relay to
+            # suppress): the client already received resume_skip chars
+            # of this prefix — emit only what UTF-8 hold-back kept
+            # unemitted at preempt time. Emitted text is monotone in the
+            # ids, so the slice can never drop undelivered bytes.
+            piece = piece[req.resume_skip:] or None
         req.resume_emitted = True
+        req.resume_skip = 0
         slot = _Slot(
             req=req,
             detok=detok,
@@ -2477,8 +2775,13 @@ class BatchedJaxEngine(JaxEngine):
             self._wasted_steps += min(
                 slot.decode_chunks_inflight * self.chunk_len, remaining)
         # Any finish frees a slot — errors included — so all of them feed
-        # the drain-rate estimate behind retry_after_hint().
-        self._finish_times.append(time.monotonic())
+        # the drain-rate estimate behind retry_after_hint(); the per-lane
+        # deque prices Retry-After for THAT lane's sheds.
+        t_fin = time.monotonic()
+        self._finish_times.append(t_fin)
+        lane = getattr(slot.req, "lane", LANE_INTERACTIVE)
+        self._lane_finish.setdefault(
+            lane, collections.deque(maxlen=64)).append(t_fin)
         if error is not None:
             if slot.req.trace is not None:
                 slot.req.trace.event(
@@ -2555,21 +2858,28 @@ class BatchedJaxEngine(JaxEngine):
             seed = zlib.crc32(prompt.encode("utf-8", "surrogatepass")) \
                 & 0x7FFFFFFF
         seed = int(seed) & 0x7FFFFFFF
-        # Load shedding at submit time: beyond max_queue_depth every queued
-        # request would wait multiple full batches for a slot — reject in
-        # microseconds with a drain-rate-priced Retry-After rather than
-        # holding the connection until the 504 at llm_timeout.
+        # QoS classification (ISSUE 7): tenant key + priority lane ride
+        # a contextvar from the HTTP layer (server/app.py middleware);
+        # direct engine calls default to one interactive anon bucket —
+        # the pre-QoS behaviour.
+        qctx = current_qos()
+        tenant = (qctx.tenant if qctx is not None else "") or ANON_TENANT
+        lane = (qctx.lane if qctx is not None
+                and qctx.lane in LANES else LANE_INTERACTIVE)
         trace = current_trace()
-        depth = self._admissions.qsize()
-        if self.max_queue_depth and depth >= self.max_queue_depth:
-            self._rejections += 1
-            if trace is not None:
-                trace.event(f"engine: admission queue full "
-                            f"({depth}/{self.max_queue_depth}) — shed")
-            raise EngineOverloaded(
-                f"admission queue full ({depth}/{self.max_queue_depth})",
-                retry_after=self.retry_after_hint(),
-            )
+        loop = asyncio.get_running_loop()
+        if self.faults is not None and not getattr(self, "_warming", False):
+            # tenant:flood:<n> drill — a synthetic background-tenant
+            # burst lands ahead of this submission, so the request that
+            # armed the probe experiences the contention under test.
+            # The engine's own start()-warm-up generate must not consume
+            # the one-shot (hence the _warming guard).
+            burst = self.faults.tenant_flood()
+            if burst:
+                if trace is not None:
+                    trace.event(f"qos: tenant:flood drill injecting "
+                                f"{burst} synthetic requests")
+                self._inject_flood(burst, loop)
         t_submit = time.monotonic()
         deadline = (t_submit + timeout) if timeout else None
         max_tokens = max(1, min(max_tokens, self.max_seq_len - 1))
@@ -2578,7 +2888,7 @@ class BatchedJaxEngine(JaxEngine):
             max_tokens=max_tokens,
             temperature=temperature,
             deadline=deadline,
-            loop=asyncio.get_running_loop(),
+            loop=loop,
             out_queue=asyncio.Queue(),
             cancel=threading.Event(),
             t_submit=t_submit,
@@ -2587,15 +2897,51 @@ class BatchedJaxEngine(JaxEngine):
             prompt=prompt,
             resume_ids=list(resume_ids) if resume_ids else None,
             export=export,
+            tenant=tenant,
+            lane=lane,
         )
+        # Fair-share load shedding at submit time (QoSQueue policy):
+        # past the per-tenant cap → 429 to the flooding tenant; past
+        # MAX_QUEUE_DEPTH → displace the dominant tenant's newest
+        # request for a quiet arrival, shed the arrival itself only
+        # when ITS tenant is the flood. Retry-After is priced from the
+        # shed lane's own drain rate.
+        try:
+            displaced = self._admissions.put(req)
+        except TenantOverloaded as e:
+            self._rejections += 1
+            e.retry_after = max(0.0, self.retry_after_hint(lane=lane))
+            if trace is not None:
+                trace.event(f"qos: shed at per-tenant cap — {e}")
+            raise
+        except EngineOverloaded as e:
+            self._rejections += 1
+            e.retry_after = max(0.0, self.retry_after_hint(lane=lane))
+            if trace is not None:
+                trace.event(f"engine: admission queue full — shed ({e})")
+            raise
+        for victim in displaced:
+            self._rejections += 1
+            if victim.trace is not None:
+                victim.trace.event(
+                    "qos: displaced from the full admission queue "
+                    f"(tenant {victim.tenant!r} holds the largest share)")
+            self._emit(victim, "error", EngineOverloaded(
+                f"displaced from a full admission queue (tenant "
+                f"{victim.tenant!r} holds the largest queue share)",
+                retry_after=self.retry_after_hint(lane=victim.lane)))
         if trace is not None:
             trace.event(f"engine: submitted to batch scheduler "
-                        f"(queue depth {depth}, sampling seed {seed})")
-        self._admissions.put(req)
+                        f"(queue depth {self._admissions.qsize()}, "
+                        f"tenant {tenant!r}, lane {lane}, "
+                        f"sampling seed {seed})")
         try:
             while True:
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
+                # Read the LIVE deadline off the request: preemption
+                # credits paused wall time back onto it, and this loop
+                # must honour the extension, not the submit-time value.
+                if req.deadline is not None:
+                    remaining = req.deadline - time.monotonic()
                     # Worker enforces the deadline too; +2s grace covers a
                     # chunk in flight before declaring it stuck.
                     try:

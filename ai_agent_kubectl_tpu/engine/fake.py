@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import queue as _queue
 import time
 import zlib
 from collections import deque
@@ -28,10 +29,12 @@ from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
                           CAUSE_SLOT_HEALTH, PROBATION_CLEAN_CHUNKS,
                           REASON_HEALTH, REASON_ISOLATED, EngineSupervisor)
 from .fallback import extract_query, rule_command  # rules promoted there
-from .protocol import (HEALTH_NONFINITE, EngineResult, EngineUnavailable,
-                       GenerationTimeout, RequestExport, RequestQuarantined,
-                       consume_chunk_row, pack_chunk, scan_chunk_row,
-                       unpack_chunk)
+from .protocol import (HEALTH_NONFINITE, EngineOverloaded, EngineResult,
+                       EngineUnavailable, GenerationTimeout, RequestExport,
+                       RequestQuarantined, consume_chunk_row, pack_chunk,
+                       scan_chunk_row, unpack_chunk)
+from .qos import (ANON_TENANT, LANE_BACKGROUND, LANE_BATCH, LANE_INTERACTIVE,
+                  LANES, BrownoutController, QoSQueue, current_qos, lane_rank)
 
 
 class FakeEngine:
@@ -129,6 +132,20 @@ class _FakeReq:
     suspect: bool = False         # in the standing bisection pool
     resume_ids: Optional[List[int]] = None   # fleet migration import
     export: Optional[RequestExport] = None   # live generated-ids view
+    # QoS ring (ISSUE 7) — mirror of the batcher's _Request fields so
+    # the fair-share queue, preemption, and brownout are testable on
+    # the fake in milliseconds.
+    tenant: str = ANON_TENANT
+    lane: str = LANE_INTERACTIVE
+    t_submit: float = 0.0
+    t_enqueue: float = 0.0
+    preempt_count: int = 0
+    preempt_t0: Optional[float] = None
+    # True once the resume prefix's text has reached the client (set by
+    # preemption — the fake's pieces are always fully emitted, so
+    # suppression is whole-prefix; fleet migrations leave it False and
+    # the relay suppresses by length instead).
+    resume_emitted: bool = False
 
 
 @dataclasses.dataclass
@@ -163,6 +180,12 @@ class FakeChunkedEngine:
                  slot_health_check: bool = True,
                  quarantine_retry_budget: int = 1,
                  reset_max_per_min: int = 60,
+                 max_queue_depth: int = 0,
+                 tenant_max_queue: int = 0,
+                 lane_weights: Optional[Dict[str, int]] = None,
+                 preempt_wait_ms: float = 0.0,
+                 preempt_budget: int = 2,
+                 slo_interactive_ms: float = 0.0,
                  faults=None,
                  stream_fn: Optional[Callable[[str], List[int]]] = None):
         if chunk_pipe_depth < 1:
@@ -176,7 +199,24 @@ class FakeChunkedEngine:
         self._ready = False
         self._slots: List[Optional[_FakeSlot]] = [None] * batch_size
         self._inflight: List[tuple] = []   # ("chunk", packed, snapshot)
-        self._queue: deque = deque()
+        # QoS ring (ISSUE 7) — same fair-share queue + brownout +
+        # preemption policy objects the batcher runs, over the fake's
+        # numpy state, so the fairness/preemption matrix is testable in
+        # milliseconds. Defaults (unbounded queue, preemption off) keep
+        # pre-QoS tests byte-identical.
+        self.max_queue_depth = max(0, max_queue_depth)
+        self.preempt_wait_ms = max(0.0, preempt_wait_ms)
+        self.preempt_budget = max(0, preempt_budget)
+        self._brownout = BrownoutController(slo_interactive_ms)
+        self._preemptions = 0
+        self._preempted_tokens = 0
+        self._preempt_times: deque = deque(maxlen=512)
+        self._preempt_for_lane: Optional[str] = None
+        self._queue: QoSQueue = QoSQueue(
+            max_depth=self.max_queue_depth,
+            tenant_cap=max(0, tenant_max_queue),
+            weights=lane_weights,
+            on_expire=self._expire_queued)
         self._task: Optional[asyncio.Task] = None
         self._monitor: Optional[asyncio.Task] = None
         #: testing/faults.py injector (decode / scheduler points).
@@ -258,8 +298,7 @@ class FakeChunkedEngine:
             slot.req.out_queue.put_nowait(
                 ("error", EngineUnavailable("engine stopped")))
         self._parked.clear()
-        while self._queue:
-            req = self._queue.popleft()
+        for req in self._queue.drain():
             req.out_queue.put_nowait(
                 ("error", EngineUnavailable("engine stopped")))
         self._inflight.clear()
@@ -272,7 +311,16 @@ class FakeChunkedEngine:
     def stats(self) -> dict:
         return {
             "batch_occupancy": sum(s is not None for s in self._slots),
-            "queue_depth": len(self._queue),
+            "queue_depth": self._queue.qsize(),
+            "qos": dict(self._queue.stats(),
+                        lane_occupancy=self.lane_occupancy(),
+                        preemptions=self._preemptions,
+                        preempted_tokens=self._preempted_tokens,
+                        brownout_level=self._brownout.level,
+                        brownout_transitions=self._brownout.transitions,
+                        lane_shares={
+                            k: round(v, 4)
+                            for k, v in self._brownout.shares.items()}),
             "pipe_depth": self.chunk_pipe_depth,
             "pipe_inflight": len(self._inflight),
             "device_active_slots": self._last_n_alive,
@@ -324,8 +372,8 @@ class FakeChunkedEngine:
                 for slot in survivors + self._parked:
                     slot.req.out_queue.put_nowait(("error", err))
                 self._parked.clear()
-                while self._queue:
-                    self._queue.popleft().out_queue.put_nowait(("error", err))
+                for req in self._queue.drain():
+                    req.out_queue.put_nowait(("error", err))
                 return
             self.supervisor.note_reset(CAUSE_SCHEDULER_DEATH)
             for slot in survivors:
@@ -343,6 +391,11 @@ class FakeChunkedEngine:
             # are exonerated earlier, in _consume_oldest.
             self._unpark_parked()
             return True
+        # QoS ring: brownout evaluation + preemptive decode (mirror of
+        # the batcher's worker-loop placement — the freed slot is handed
+        # to the starved lane by the _admit_pending call right below).
+        self._brownout.maybe_eval()
+        self._maybe_preempt()
         self._admit_pending()
         self._prune_dead_chunks()
         n_active = sum(s is not None for s in self._slots)
@@ -366,23 +419,161 @@ class FakeChunkedEngine:
                              error=GenerationTimeout("generation timeout"),
                              wasted_inflight=True)
 
+    # --------------------------------------------- QoS ring (ISSUE 7)
+
+    def lane_occupancy(self) -> Dict[str, int]:
+        """Slots held per lane (mirror of the batcher's — the fleet's
+        lane-aware router reads this)."""
+        counts = {lane: 0 for lane in LANES}
+        for s in self._slots:
+            if s is not None:
+                lane = getattr(s.req, "lane", LANE_INTERACTIVE)
+                counts[lane if lane in LANES else LANE_INTERACTIVE] += 1
+        return counts
+
+    def _capped_lanes(self, counts: Dict[str, int]) -> tuple:
+        capped = []
+        for lane in (LANE_BACKGROUND, LANE_BATCH):
+            cap = self._brownout.lane_cap(lane, self.batch_size)
+            if cap < self.batch_size and counts.get(lane, 0) >= cap:
+                capped.append(lane)
+        return tuple(capped)
+
+    def _expire_queued(self, req: _FakeReq) -> None:
+        req.out_queue.put_nowait(
+            ("error", GenerationTimeout("deadline expired while queued")))
+
+    def _credit_preempt_wait(self, req: _FakeReq) -> None:
+        t0 = req.preempt_t0
+        if t0 is None:
+            return
+        req.preempt_t0 = None
+        if req.deadline is not None:
+            req.deadline += time.monotonic() - t0
+
+    def _maybe_preempt(self) -> bool:
+        """Mirror of the batcher's preemptive decode over the fake's
+        scripted streams: export the cheapest lower-lane victim, free
+        its slot for the starved lane, replay bit-identically later
+        (the scripted stream IS the seeded-sampling determinism)."""
+        if self.preempt_wait_ms <= 0 or self._parked:
+            return False
+        if any(s is None for s in self._slots):
+            return False
+        now = time.monotonic()
+        lane = self._queue.starved_lane(
+            now, self.preempt_wait_ms / 1000.0,
+            exclude=self._capped_lanes(self.lane_occupancy()))
+        if lane is None:
+            return False
+        rank = lane_rank(lane)
+        victims = [
+            (i, s) for i, s in enumerate(self._slots)
+            if s is not None
+            and lane_rank(getattr(s.req, "lane", LANE_INTERACTIVE)) < rank
+            and s.req.preempt_count < self.preempt_budget
+        ]
+        if not victims:
+            return False
+        idx, _ = min(victims, key=lambda t: (lane_rank(t[1].req.lane),
+                                             len(t[1].emitted)))
+        self._preempt_slot(idx, lane)
+        self._preempt_for_lane = lane
+        return True
+
+    def _preempt_slot(self, idx: int, for_lane: str) -> None:
+        slot = self._slots[idx]
+        self._slots[idx] = None
+        req = slot.req
+        req.preempt_count += 1
+        req.preempt_t0 = time.monotonic()
+        req.resume_ids = list(slot.emitted)
+        req.resume_emitted = True    # fake pieces are always fully emitted
+        if req.export is not None:
+            req.export.ids = list(slot.emitted)
+        if self.device_termination and slot.decode_chunks_inflight > 0:
+            remaining = max(0, req.max_tokens - len(slot.emitted))
+            self._wasted_steps += min(
+                slot.decode_chunks_inflight * self.chunk_len, remaining)
+        self._preemptions += 1
+        self._preempted_tokens += len(slot.emitted)
+        self._preempt_times.append(req.preempt_t0)
+        self._queue.requeue_head(req)
+
+    def _inject_flood(self, n: int) -> None:
+        """tenant:flood:<n> drill — synthetic background-tenant burst
+        (mirror of the batcher's)."""
+        from ..testing.faults import FLOOD_LANE, FLOOD_TENANT
+
+        now = time.monotonic()
+        for i in range(n):
+            prompt = f"tenant flood drill {i}"
+            req = _FakeReq(
+                prompt=prompt,
+                max_tokens=32,
+                deadline=now + 30.0,
+                out_queue=asyncio.Queue(),
+                cancel=asyncio.Event(),
+                stream=list(self.stream_fn(prompt)),
+                seed=i,
+                tenant=FLOOD_TENANT,
+                lane=FLOOD_LANE,
+                t_submit=now,
+            )
+            try:
+                self._queue.put(req)
+            except EngineOverloaded:
+                break
+
+    def qos_health(self) -> dict:
+        now = time.monotonic()
+        return {
+            "lanes": self._queue.lane_depths(),
+            "brownout_level": self._brownout.level,
+            "lane_shares": {k: round(v, 4)
+                            for k, v in self._brownout.shares.items()},
+            "preemptions_total": self._preemptions,
+            "preemptions_last_60s": sum(
+                1 for t in list(self._preempt_times) if t >= now - 60.0),
+            "queue_expired_total": self._queue.expired_total,
+            "queue_displaced_total": self._queue.displaced_total,
+        }
+
     def _admit_pending(self) -> None:
         if self._parked:
             # Bisection probation (mirror of the batcher): no new
             # admissions may join a suspect batch; queued requests wait
             # and are never dropped.
             return
-        while self._queue and None in self._slots:
-            req = self._queue.popleft()
+        counts = self.lane_occupancy()
+        prefer, self._preempt_for_lane = self._preempt_for_lane, None
+        while None in self._slots:
+            try:
+                req = self._queue.get_nowait(
+                    exclude_lanes=self._capped_lanes(counts),
+                    min_lane=prefer)
+            except _queue.Empty:
+                if prefer is None:
+                    break
+                prefer = None
+                continue
+            prefer = None
             if req.cancel.is_set():
                 continue
+            self._credit_preempt_wait(req)
+            lane = req.lane if req.lane in LANES else LANE_INTERACTIVE
+            counts[lane] += 1
+            if req.t_submit:
+                self._brownout.note_queue_wait(
+                    lane, (time.monotonic() - req.t_submit) * 1000.0)
             i = self._slots.index(None)
             if req.resume_ids:
-                # Cross-replica import (fleet migration): re-seat from
-                # the portable generated prefix — device cursors resume
-                # at g, and the prefix TEXT is re-emitted for the fleet
-                # relay to suppress (mirror of the batcher's
-                # _admit_resume).
+                # Cross-replica import (fleet migration) or preemption
+                # resume: re-seat from the portable generated prefix —
+                # device cursors resume at g. The prefix TEXT is
+                # re-emitted only for migrations (the fleet relay
+                # suppresses it); a preempted victim's client already
+                # has it (resume_emitted).
                 g = len(req.resume_ids)
                 slot = _FakeSlot(
                     req=req, emitted=list(req.resume_ids), dev_idx=g,
@@ -390,8 +581,10 @@ class FakeChunkedEngine:
                     dev_active=(g < req.max_tokens
                                 if self.device_termination else True),
                     last_tok=req.resume_ids[-1])
-                req.out_queue.put_nowait(
-                    ("token", self._piece(slot.emitted, 0)))
+                if not req.resume_emitted:
+                    req.out_queue.put_nowait(
+                        ("token", self._piece(slot.emitted, 0)))
+                req.resume_emitted = True
                 if req.export is not None:
                     req.export.ids = list(slot.emitted)
                 self._slots[i] = slot
@@ -749,18 +942,38 @@ class FakeChunkedEngine:
         if seed is None:
             seed = zlib.crc32(
                 prompt.encode("utf-8", "surrogatepass")) & 0x7FFFFFFF
+        # QoS classification + fair-share admission (mirror of the
+        # batcher's submit path).
+        qctx = current_qos()
+        tenant = (qctx.tenant if qctx is not None else "") or ANON_TENANT
+        lane = (qctx.lane if qctx is not None
+                and qctx.lane in LANES else LANE_INTERACTIVE)
+        if self.faults is not None:
+            burst = self.faults.tenant_flood()
+            if burst:
+                self._inject_flood(burst)
+        now = time.monotonic()
         req = _FakeReq(
             prompt=prompt,
             max_tokens=max(1, max_tokens),
-            deadline=(time.monotonic() + timeout) if timeout else None,
+            deadline=(now + timeout) if timeout else None,
             out_queue=asyncio.Queue(),
             cancel=asyncio.Event(),
             stream=list(self.stream_fn(prompt)),
             seed=int(seed),
             resume_ids=list(resume_ids) if resume_ids else None,
             export=export,
+            tenant=tenant,
+            lane=lane,
+            t_submit=now,
         )
-        self._queue.append(req)
+        # put() raises TenantOverloaded (429) at the per-tenant cap and
+        # EngineOverloaded when this tenant floods a full queue; a quiet
+        # arrival instead displaces the flooder's newest request.
+        for victim in self._queue.put(req):
+            victim.out_queue.put_nowait(("error", EngineOverloaded(
+                f"displaced from a full admission queue (tenant "
+                f"{victim.tenant!r} holds the largest queue share)")))
         try:
             while True:
                 if req.deadline is not None:

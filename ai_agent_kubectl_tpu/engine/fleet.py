@@ -61,6 +61,7 @@ from ..obs.trace import current_trace
 from ..server.breaker import OPEN, CircuitBreaker
 from .protocol import (EngineOverloaded, EngineResult, EngineUnavailable,
                        GenerationTimeout, RequestExport, RequestQuarantined)
+from .qos import LANE_INTERACTIVE, LANES, current_qos, lane_rank
 
 logger = logging.getLogger(__name__)
 
@@ -141,9 +142,12 @@ class PrefixAffinity:
 @dataclasses.dataclass(eq=False)   # identity hash: flights live in sets
 class _Flight:
     """One in-flight fleet request, registered with the replica serving
-    it so ``drain()`` can nudge it to migrate."""
+    it so ``drain()`` can nudge it to migrate. ``lane`` (QoS ring) lets
+    drains evict background work first and the router count only the
+    occupancy a given lane actually contends with."""
 
     migrate: asyncio.Event = dataclasses.field(default_factory=asyncio.Event)
+    lane: str = LANE_INTERACTIVE
 
 
 class _Replica:
@@ -168,6 +172,26 @@ class _Replica:
         if slots:
             return sum(s is not None for s in slots)
         return self.inflight
+
+    def occupancy_for(self, lane: Optional[str]) -> int:
+        """Lane-aware occupancy (QoS ring): slots a request at ``lane``
+        actually contends with — lower-lane slots are preemptible, so a
+        replica full of background work is still routable for
+        interactive traffic."""
+        fn = getattr(self.engine, "lane_occupancy", None)
+        if lane is None or not callable(fn):
+            return self.occupancy()
+        rank = lane_rank(lane)
+        return sum(n for la, n in fn().items() if lane_rank(la) >= rank)
+
+    def inflight_for(self, lane: Optional[str]) -> int:
+        """Fleet relays dispatched here at or above ``lane``."""
+        if lane is None:
+            return self.inflight
+        rank = lane_rank(lane)
+        return sum(1 for f in self.flights
+                   if lane_rank(getattr(f, "lane", LANE_INTERACTIVE))
+                   >= rank)
 
 
 class EngineFleet:
@@ -375,7 +399,12 @@ class EngineFleet:
             self.affinity.forget_replica(idx)
         logger.warning("fleet: replica %d ejected (%s); %d in-flight "
                        "request(s) migrating", idx, cause, len(rep.flights))
-        for flight in list(rep.flights):
+        # Lowest lane first (QoS): on a crash-eject everyone migrates
+        # this tick anyway, but the ordering keeps background's
+        # re-splice load ahead of interactive's on the receiving side.
+        for flight in sorted(
+                rep.flights,
+                key=lambda f: lane_rank(getattr(f, "lane", None))):
             flight.migrate.set()
 
     async def drain(self, idx: int,
@@ -396,6 +425,24 @@ class EngineFleet:
         logger.info("fleet: draining replica %d (%d in-flight)",
                     idx, len(rep.flights))
         if self._routable():
+            # QoS eviction order: background (and batch) migrate FIRST;
+            # interactive flights keep decoding here until the lower
+            # lanes have re-seated (or a slice of the budget passes) so
+            # the sibling absorbs the bulk re-splices before the
+            # latency-sensitive ones arrive.
+            lower = [f for f in rep.flights
+                     if lane_rank(getattr(f, "lane", None))
+                     < lane_rank(LANE_INTERACTIVE)]
+            for flight in sorted(
+                    lower, key=lambda f: lane_rank(getattr(f, "lane",
+                                                           None))):
+                flight.migrate.set()
+            if lower:
+                stage_deadline = time.monotonic() + min(
+                    1.0, drain_secs * 0.25)
+                while (any(f in rep.flights for f in lower)
+                       and time.monotonic() < stage_deadline):
+                    await asyncio.sleep(0.01)
             for flight in list(rep.flights):
                 flight.migrate.set()
         elif rep.flights:
@@ -449,15 +496,21 @@ class EngineFleet:
             and rep.breaker.state != OPEN
         ]
 
-    def _route(self, prompt: str,
-               exclude: Sequence[int] = ()) -> Optional[_Replica]:
+    def _route(self, prompt: str, exclude: Sequence[int] = (),
+               lane: Optional[str] = None) -> Optional[_Replica]:
         """Health-aware pick: least-loaded among routable replicas,
         overridden by prefix affinity unless the preferred replica is
-        more than AFFINITY_SLACK requests busier."""
+        more than AFFINITY_SLACK requests busier. With ``lane`` set the
+        load keys are lane-aware (QoS ring): only in-flight work at or
+        above the request's lane counts, so a replica whose slots are
+        all preemptible background work routes like an idle one for
+        interactive traffic."""
         cands = self._routable(exclude)
         if not cands:
             return None
-        best = min(cands, key=lambda r: (r.inflight, r.occupancy(), r.idx))
+        best = min(cands, key=lambda r: (r.inflight_for(lane),
+                                         r.occupancy_for(lane),
+                                         r.inflight, r.idx))
         if self.affinity is not None:
             want = self.affinity.lookup(prompt)
             if want is not None and want != best.idx:
@@ -550,7 +603,12 @@ class EngineFleet:
         seed = int(seed) & 0x7FFFFFFF
         deadline = (time.monotonic() + timeout) if timeout else None
         trace = current_trace()
-        flight = _Flight()
+        # QoS lane rides the same contextvar the engines read; the fleet
+        # uses it for lane-aware routing and drain-eviction ordering.
+        qctx = current_qos()
+        flight = _Flight(lane=(qctx.lane if qctx is not None
+                               and qctx.lane in LANES
+                               else LANE_INTERACTIVE))
         delivered = ""               # text already yielded to the caller
         export_ids: List[int] = []   # best-known generated prefix (ids)
         migrations = 0
@@ -559,13 +617,17 @@ class EngineFleet:
         overload_tried: List[int] = []
 
         while True:
-            rep = self._route(prompt, exclude=exclude + overload_tried)
+            rep = self._route(prompt, exclude=exclude + overload_tried,
+                              lane=flight.lane)
             if rep is None:
                 if isinstance(last_err, EngineOverloaded):
                     # Every routable replica shed: propagate, re-priced
                     # from the FLEET-wide drain rate (a single replica's
-                    # estimate undersells N replicas draining).
-                    raise EngineOverloaded(
+                    # estimate undersells N replicas draining). The
+                    # CLASS is preserved — a per-tenant 429
+                    # (TenantOverloaded) must stay a 429 through the
+                    # fleet, not dilute into everyone's 503.
+                    raise type(last_err)(
                         str(last_err),
                         retry_after=self.retry_after_hint())
                 raise last_err or EngineUnavailable(
@@ -743,7 +805,8 @@ class EngineFleet:
                     # to a second replica and race the branches.
                     hedge_armed = False
                     alt = self._route(
-                        prompt, exclude=[b["rep"].idx for b in branches])
+                        prompt, exclude=[b["rep"].idx for b in branches],
+                        lane=flight.lane)
                     if alt is not None:
                         self._hedges += 1
                         trace = current_trace()
@@ -867,6 +930,32 @@ class EngineFleet:
                 return min(max(depth / rate, 1.0), 60.0)
         return 5.0
 
+    def qos_health(self) -> dict:
+        """Fleet rollup of the replicas' cheap QoS views (/health
+        section): lane depths sum, brownout reports the worst replica,
+        preemption/expiry counters sum."""
+        agg: dict = {"lanes": {}, "brownout_level": 0,
+                     "preemptions_total": 0, "preemptions_last_60s": 0,
+                     "queue_expired_total": 0, "queue_displaced_total": 0}
+        seen = False
+        for rep in self.replicas:
+            fn = getattr(rep.engine, "qos_health", None)
+            if not callable(fn):
+                continue
+            try:
+                q = fn() or {}
+            except Exception:   # pragma: no cover - stopped replica
+                continue
+            seen = True
+            for lane, n in (q.get("lanes") or {}).items():
+                agg["lanes"][lane] = agg["lanes"].get(lane, 0) + n
+            agg["brownout_level"] = max(agg["brownout_level"],
+                                        q.get("brownout_level", 0))
+            for k in ("preemptions_total", "preemptions_last_60s",
+                      "queue_expired_total", "queue_displaced_total"):
+                agg[k] += q.get(k, 0)
+        return agg if seen else {}
+
     def fleet_health(self) -> dict:
         """Cheap per-replica health view for /health (never calls
         stats() — that drains metric samples owed to the scrape)."""
@@ -936,6 +1025,7 @@ class EngineFleet:
                              "health_trips": 0, "replayed_tokens": 0,
                              "replayed_requests": 0, "parked": 0}
         per_replica = []
+        replica_stats = []
         for rep in self.replicas:
             fn = getattr(rep.engine, "stats", None)
             s = {}
@@ -944,6 +1034,7 @@ class EngineFleet:
                     s = fn() or {}
                 except Exception:  # pragma: no cover - stopped replica
                     s = {}
+            replica_stats.append(s)
             for k in self._SUM_KEYS:
                 v = s.get(k)
                 if isinstance(v, (int, float)):
@@ -975,6 +1066,29 @@ class EngineFleet:
             })
         agg["chunk_fetch_secs"] = fetch_samples
         agg["containment"] = containment
+        # QoS aggregation: depths/occupancy/counters sum; brownout is
+        # the worst replica's level (the fleet is as browned-out as its
+        # most-pressured member).
+        qos: dict = {"lane_depth": {}, "lane_occupancy": {},
+                     "expired": 0, "displaced": 0, "preemptions": 0,
+                     "preempted_tokens": 0, "brownout_level": 0,
+                     "tenants": 0}
+        have_qos = False
+        for s in replica_stats:
+            q = s.get("qos")
+            if not q:
+                continue
+            have_qos = True
+            for key in ("lane_depth", "lane_occupancy"):
+                for lane, n in (q.get(key) or {}).items():
+                    qos[key][lane] = qos[key].get(lane, 0) + n
+            for key in ("expired", "displaced", "preemptions",
+                        "preempted_tokens", "tenants"):
+                qos[key] += q.get(key, 0)
+            qos["brownout_level"] = max(qos["brownout_level"],
+                                        q.get("brownout_level", 0))
+        if have_qos:
+            agg["qos"] = qos
         fleet = self.fleet_health()
         fleet["replicas"] = per_replica
         agg["fleet"] = fleet

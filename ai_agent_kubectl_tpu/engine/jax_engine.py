@@ -212,11 +212,17 @@ class JaxEngine:
         # steady-state TTFT. _ready must already be True here (generate()
         # gates on it); start() just doesn't return until warmup is done,
         # and the server awaits start() before accepting traffic.
+        # _warming marks the warm-up for QoS fault drills: a one-shot
+        # tenant:flood must fire on the first REAL submission, not be
+        # consumed (and drained) by the engine's own warm-up request.
+        self._warming = True
         try:
             await self.generate("warmup: list pods", max_tokens=2,
                                 temperature=0.0)
         except Exception:  # pragma: no cover - warmup must never kill startup
             logger.exception("warmup generation failed")
+        finally:
+            self._warming = False
 
     def _setup_compile_cache(self) -> None:
         """Point XLA's persistent compilation cache at COMPILE_CACHE_DIR so

@@ -29,6 +29,23 @@ class EngineOverloaded(EngineUnavailable):
         self.retry_after = max(0.0, float(retry_after))
 
 
+class TenantOverloaded(EngineOverloaded):
+    """Per-TENANT admission cap hit (QoS ring, engine/qos.py) → HTTP 429.
+
+    Deliberately an ``EngineOverloaded`` subclass: to the fleet router
+    one replica's tenant-cap shed is still backpressure (reroute, don't
+    migrate), and to the breaker it still says nothing about engine
+    health. The HTTP layer maps it to 429 instead of 503 — the flooding
+    tenant is told to back off, everyone else keeps being served —
+    with ``Retry-After`` priced from the shed lane's own drain rate."""
+
+    def __init__(self, message: str, retry_after: float = 1.0,
+                 tenant: str = "", lane: str = ""):
+        super().__init__(message, retry_after=retry_after)
+        self.tenant = tenant
+        self.lane = lane
+
+
 class GenerationTimeout(TimeoutError):
     """Generation exceeded the configured timeout → HTTP 504
     (reference app.py:189-191)."""

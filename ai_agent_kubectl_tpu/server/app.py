@@ -37,7 +37,8 @@ from ..config import ServiceConfig
 from ..engine.fallback import FallbackEngine
 from ..engine.protocol import (Engine, EngineOverloaded, EngineResult,
                                EngineUnavailable, GenerationTimeout,
-                               RequestQuarantined)
+                               RequestQuarantined, TenantOverloaded)
+from ..engine.qos import classify, use_qos
 from ..engine.prompts import render_prompt
 from ..obs import (PHASES, FlightRecorder, Trace, current_trace,
                    new_request_id, sanitize_request_id, use_trace)
@@ -135,6 +136,11 @@ class Service:
         # engine_tokens_per_sec gauge at scrape time (see WindowedRate).
         self.recorder = FlightRecorder(cfg.flight_recorder_size)
         self.token_rate = WindowedRate()
+        # QoS ring (ISSUE 7): the tenant→tier map is parsed once at
+        # startup (a typo'd TENANT_TIERS already refused to boot in
+        # ServiceConfig.__post_init__); the qos middleware classifies
+        # every generation request against it.
+        self.tenant_tiers = cfg.tenant_tier_map
         # Inner ring → outer ring: every engine reset-and-replay also
         # counts as a breaker failure, so a flapping engine (reset storm)
         # opens the breaker even while individual requests keep
@@ -437,6 +443,44 @@ async def auth_middleware(request: web.Request, handler):
     return await handler(request)
 
 
+@web.middleware
+async def qos_middleware(request: web.Request, handler):
+    """QoS classification (ISSUE 7): every generation request gets a
+    tenant key (its API key, else its rate-limit client IP) and a
+    priority lane (X-Priority, clamped by the tenant's TENANT_TIERS
+    tier), carried to the engine scheduler on a contextvar — the same
+    cross-await channel the trace rides. Innermost middleware: only
+    authenticated traffic is classified."""
+    svc: Service = request.app["service"]
+    if request.path not in GENERATE_ROUTES:
+        return await handler(request)
+    # The API key is the tenant key ONLY when the operator registered it
+    # in TENANT_TIERS. A raw header would let a flooder mint a fresh
+    # tenant per request (spoofed random keys dodge every per-tenant
+    # cap and displace honest tenants as "dominant"), and under
+    # single-key auth it would collapse every user into one bucket.
+    # Unregistered traffic buckets by client IP — the same identity the
+    # rate limiter uses.
+    api_key = request.headers.get("X-API-Key")
+    if api_key not in svc.tenant_tiers:
+        api_key = None
+    ctx = classify(
+        api_key,
+        _client_key(request),
+        request.headers.get("X-Priority"),
+        svc.tenant_tiers,
+        svc.cfg.qos_default_lane,
+    )
+    trace = current_trace()
+    if trace is not None:
+        # The lane is safe to log; the tenant key may be an API key —
+        # the trace records only which kind keyed it.
+        trace.event(f"qos: lane={ctx.lane} "
+                    f"(tenant={'tier-key' if api_key else 'client-ip'})")
+    with use_qos(ctx):
+        return await handler(request)
+
+
 def _record_engine_spans(trace: Optional[Trace], t_block0: float,
                          t_block1: float, er: EngineResult) -> None:
     """Reconstruct the engine block's phase timeline onto the trace.
@@ -490,6 +534,12 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
         command, from_cache, engine_result, degraded = await svc.generate_command(
             sanitized_query
         )
+    except TenantOverloaded as e:
+        # 429, not 503: the per-TENANT cap tripped — the flooding tenant
+        # backs off while everyone else keeps being served; Retry-After
+        # is priced from the shed lane's own drain rate.
+        return _json_error(429, f"Tenant over queue quota: {e}",
+                           headers=_retry_after_header(e.retry_after))
     except EngineOverloaded as e:
         return _json_error(503, f"Server overloaded: {e}",
                            headers=_retry_after_header(e.retry_after))
@@ -689,6 +739,10 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
     except UnsafeCommandError as e:
         svc.metrics.unsafe_commands.labels("llm").inc()
         await write_safe(sse(str(e), event="error"))
+    except TenantOverloaded as e:
+        # In-band 429 analog: THIS tenant is over its queue quota.
+        await write_safe(sse(f"tenant over queue quota: {e}",
+                             event="error"))
     except EngineOverloaded as e:
         # Shedding stays an error even with the fallback enabled: the
         # client should back off, not be absorbed by the rule table.
@@ -817,6 +871,13 @@ async def handle_health(request: web.Request) -> web.Response:
     if fleet is not None and last_reset is None:
         last_reset = fleet.get("last_reset")
         last_cause = fleet.get("last_reset_cause")
+    # QoS ring (ISSUE 7): per-lane queue depth, brownout level/shares,
+    # and preemptions in the last minute — the cheap view (qos_health
+    # never calls stats(), same rule as the fleet section).
+    qos = None
+    qh = getattr(svc.engine, "qos_health", None)
+    if callable(qh):
+        qos = qh() or None
     body = HealthResponse(
         status="healthy" if ready and breaker == "closed" else "degraded",
         engine=getattr(svc.engine, "name", "unknown"),
@@ -828,6 +889,7 @@ async def handle_health(request: web.Request) -> web.Response:
         last_reset=last_reset,
         last_reset_cause=last_cause,
         fleet=fleet,
+        qos=qos,
     )
     # The HTTP status tracks engine readiness alone: an open breaker with
     # the engine process alive still serves (fallback and/or cache), and
@@ -985,6 +1047,10 @@ async def handle_metrics(request: web.Request) -> web.Response:
         # migration/hedge/drain/eject counters.
         if stats.get("fleet"):
             svc.metrics.observe_fleet(stats["fleet"])
+        # QoS section (engine/qos.py): per-lane depth/occupancy gauges +
+        # preemption/expiry/displacement counters + brownout level.
+        if stats.get("qos"):
+            svc.metrics.observe_qos(stats["qos"])
     # Windowed throughput gauge: the batcher's own scheduler-side window
     # when it reports one (counts every finish, including streams), else
     # the service-side window fed by the response handlers.
@@ -1001,7 +1067,8 @@ def create_app(cfg: ServiceConfig, engine: Engine,
     """App factory (reference module init, app.py:130-138)."""
     app = web.Application(
         middlewares=[observability_middleware, overload_middleware,
-                     ratelimit_middleware, auth_middleware]
+                     ratelimit_middleware, auth_middleware,
+                     qos_middleware]
     )
     app["service"] = Service(cfg, engine, executor=executor, metrics=metrics)
 

@@ -316,6 +316,56 @@ class Metrics:
                             "hedges": 0, "drains": 0, "ejects": 0,
                             "rejoins": 0}
 
+        # QoS ring (ISSUE 7, engine/qos.py): per-lane queue depth and
+        # slot occupancy gauges (the ``lane`` label is the closed
+        # three-lane set — cardinality bounded by construction; tenants
+        # are deliberately NEVER labels), the brownout level, and the
+        # preemption/expiry/displacement counters, delta-mirrored from
+        # stats()["qos"] like the pipeline/containment totals.
+        self.qos_queue_depth = Gauge(
+            "qos_queue_depth",
+            "Requests waiting for a decode slot, by priority lane",
+            ["lane"],
+            registry=r,
+        )
+        self.qos_lane_occupancy = Gauge(
+            "qos_lane_occupancy",
+            "Decode slots held, by priority lane",
+            ["lane"],
+            registry=r,
+        )
+        self.qos_brownout_level = Gauge(
+            "qos_brownout_level",
+            "AIMD brownout level (0=none, 1=background trimmed, "
+            "2=batch trimmed too)",
+            registry=r,
+        )
+        self.preemptions = Counter(
+            "qos_preemptions_total",
+            "Running requests preempted out of their slot for a "
+            "starved higher lane (export/replay path)",
+            registry=r,
+        )
+        self.preempted_tokens = Counter(
+            "qos_preempted_tokens_total",
+            "Generated tokens carried across preempt-and-replay",
+            registry=r,
+        )
+        self.queue_expired = Counter(
+            "queue_expired_total",
+            "Queued requests purged at scan time because their deadline "
+            "passed (they no longer occupy MAX_QUEUE_DEPTH)",
+            registry=r,
+        )
+        self.queue_displaced = Counter(
+            "queue_displaced_total",
+            "Queued requests displaced from a full queue in favour of a "
+            "quieter tenant's arrival (shed prefers the flooding tenant)",
+            registry=r,
+        )
+        self._qos_seen = {"preemptions": 0, "preempted_tokens": 0,
+                          "expired": 0, "displaced": 0}
+
         # Request-lifecycle phase attribution (obs/trace.py): where a
         # request's wall time went. The ``phase`` label is drawn from the
         # fixed obs.PHASES allowlist — cardinality is bounded by
@@ -405,6 +455,25 @@ class Metrics:
                              ("ejects", self.fleet_ejects),
                              ("rejoins", self.fleet_rejoins)):
             total = fleet.get(key, 0)
+            if total > seen[key]:
+                counter.inc(total - seen[key])
+                seen[key] = total
+
+    def observe_qos(self, qos: dict) -> None:
+        """Mirror the engine's QoS stats (stats()["qos"]) into
+        Prometheus at scrape time — gauges set directly, cumulative
+        totals delta-inc'd like the pipeline/containment mirrors."""
+        for lane, n in (qos.get("lane_depth") or {}).items():
+            self.qos_queue_depth.labels(lane=lane).set(n)
+        for lane, n in (qos.get("lane_occupancy") or {}).items():
+            self.qos_lane_occupancy.labels(lane=lane).set(n)
+        self.qos_brownout_level.set(qos.get("brownout_level", 0))
+        seen = self._qos_seen
+        for key, counter in (("preemptions", self.preemptions),
+                             ("preempted_tokens", self.preempted_tokens),
+                             ("expired", self.queue_expired),
+                             ("displaced", self.queue_displaced)):
+            total = qos.get(key, 0)
             if total > seen[key]:
                 counter.inc(total - seen[key])
                 seen[key] = total

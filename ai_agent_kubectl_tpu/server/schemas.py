@@ -95,3 +95,8 @@ class HealthResponse(BaseModel):
     # totals — plus a ``replicas`` list with each replica's state,
     # breaker, occupancy, and last reset/cause. None = no fleet layer.
     fleet: Optional[Dict[str, Any]] = None
+    # QoS ring (engine/qos.py, ISSUE 7): per-lane queue depth, the
+    # active brownout level and lane shares, preemptions in the last
+    # minute, and scan-time expiry/displacement totals. None = engine
+    # without the QoS scheduler (fake/openai single-sequence paths).
+    qos: Optional[Dict[str, Any]] = None

@@ -17,6 +17,11 @@ fault points that the engine layer checks at its seams:
   thread/task (raises a BaseException the poisoned-step containment
   deliberately cannot catch); fires ONCE then disarms, so the drill
   tests the supervisor restart, not an unrecoverable crash loop
+- ``tenant`` — ``tenant:flood:<n>`` enqueues a one-shot synthetic burst
+  of ``n`` requests from one tenant key (``FLOOD_TENANT``, background
+  lane) ahead of the next real submission, so the QoS ring's fair-share
+  admission and preemptive decode (ISSUE 7) are exercisable without a
+  load generator
 - ``generate`` — the whole engine call (applied by ``ChaosEngine``, the
   protocol wrapper the factory installs when FAULT_POINTS names it)
 
@@ -56,18 +61,24 @@ from ..engine.protocol import EngineResult, EngineUnavailable
 
 _DEFAULT_HANG_SECS = 60.0
 
-_MODES = ("error", "delay", "hang", "nan", "poison_step", "die")
+_MODES = ("error", "delay", "hang", "nan", "poison_step", "die", "flood")
 
 #: the closed set of check sites; a typo'd point in FAULT_POINTS must be
 #: a startup error, not a silently inert game-day drill.
-KNOWN_POINTS = ("admit", "chunk", "decode", "scheduler", "generate")
+KNOWN_POINTS = ("admit", "chunk", "decode", "scheduler", "tenant",
+                "generate")
 
 #: (point, mode) pairs that only make sense together — a drill spec
 #: arming e.g. ``admit:nan`` is a typo, not chaos.
 _POINT_ONLY_MODES = {"nan": ("decode",), "poison_step": ("decode",),
-                     "die": ("scheduler",)}
+                     "die": ("scheduler",), "flood": ("tenant",)}
 _RESTRICTED_POINTS = {"decode": ("nan", "poison_step"),
-                      "scheduler": ("die",)}
+                      "scheduler": ("die",), "tenant": ("flood",)}
+
+#: tenant key + lane the flood drill's synthetic burst runs under —
+#: fixed so fairness assertions and dashboards can name the flooder.
+FLOOD_TENANT = "tenant:flood"
+FLOOD_LANE = "background"
 
 
 class SchedulerKilled(BaseException):
@@ -180,6 +191,10 @@ class FaultInjector:
             )
         if mode == "delay" and arg is None:
             raise ValueError("delay mode needs seconds (point:delay:secs)")
+        if mode == "flood" and (arg is None or arg < 1):
+            # The burst size is the drill — an unsized flood is a typo.
+            raise ValueError(
+                "flood mode needs a burst size (tenant:flood:<n>)")
         if arg is not None and arg < 0:
             # A negative delay would raise inside the scheduler loop and
             # fail every active slot — a typo'd drill arg must be a
@@ -340,6 +355,23 @@ class FaultInjector:
         self._fired["decode"] = self._fired.get("decode", 0) + 1
         raise InjectedFault("injected poisoned step at chunk fetch")
 
+    def tenant_flood(self, replica: Optional[int] = None) -> int:
+        """``tenant:flood:<n>`` — one-shot synthetic tenant flood: the
+        next submission through an armed engine is preceded by ``n``
+        queued requests from one synthetic tenant (``FLOOD_TENANT``,
+        lane ``FLOOD_LANE``), so chaos tests and ``probe_serving.py``
+        can exercise fair-share admission and preemption without a load
+        generator. Returns the burst size (0 = not armed / out of
+        scope) and disarms itself, like ``scheduler:die``."""
+        fault = self._faults.get("tenant")
+        if fault is None or fault.mode != "flood":
+            return 0
+        if not self._in_scope(fault, replica):
+            return 0
+        del self._faults["tenant"]
+        self._fired["tenant"] = self._fired.get("tenant", 0) + 1
+        return int(fault.arg)
+
     def check_scheduler_die(self, replica: Optional[int] = None) -> None:
         """``scheduler:die`` — one-shot: raises ``SchedulerKilled`` (a
         BaseException) so the scheduler loop genuinely dies; disarms
@@ -410,6 +442,9 @@ class ReplicaFaults:
     def check_scheduler_die(self) -> None:
         self.inner.check_scheduler_die(replica=self.replica)
 
+    def tenant_flood(self) -> int:
+        return self.inner.tenant_flood(replica=self.replica)
+
     def describe(self) -> str:
         return f"replica {self.replica} view of [{self.inner.describe()}]"
 
@@ -450,6 +485,11 @@ class ChaosEngine:
         """Forward the per-replica /health view when the wrapped engine
         is an EngineFleet (generate-point drills wrap the whole fleet)."""
         fn = getattr(self.inner, "fleet_health", None)
+        return fn() if callable(fn) else {}
+
+    def qos_health(self) -> dict:
+        """Forward the QoS /health section (ISSUE 7) past the wrapper."""
+        fn = getattr(self.inner, "qos_health", None)
         return fn() if callable(fn) else {}
 
     def set_reset_listener(self, fn) -> None:

@@ -132,6 +132,7 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
         log(f"  wasted steps / consumed chunk {wasted / consumed:>8.2f}")
     print_containment_summary(gauges)
     print_fleet_summary(gauges)
+    print_qos_summary(gauges)
 
 
 def _sum_labelled(gauges: Dict[str, float], name: str) -> Dict[str, float]:
@@ -202,6 +203,34 @@ def print_fleet_summary(gauges: Dict[str, float]) -> None:
     consumed = gauges.get('decode_chunks_total{event="consume"}', 0.0)
     rate = f"  ({hedges / consumed:.4f}/chunk)" if consumed else ""
     log(f"  hedged dispatches total     {hedges:>8.0f}{rate}")
+
+
+def print_qos_summary(gauges: Dict[str, float]) -> None:
+    """QoS ring (ISSUE 7) from the same /metrics scrape: per-lane queue
+    depth and slot occupancy, preemption/expiry/displacement totals,
+    and the active brownout level — the fairness view next to the
+    throughput view."""
+    depth = _sum_labelled(gauges, "qos_queue_depth")
+    occ = _sum_labelled(gauges, "qos_lane_occupancy")
+    if not depth and not occ:
+        return      # engine without the QoS scheduler
+    log("probe[qos]: QoS ring")
+    for key in sorted(depth):
+        lane = key.split("=")[-1].strip('"')
+        log(f"  lane {lane:<12} queued={depth[key]:.0f} "
+            f"slots={occ.get(key, 0.0):.0f}")
+    level = gauges.get("qos_brownout_level", 0.0)
+    level_name = {0: "none", 1: "background trimmed",
+                  2: "batch trimmed"}.get(int(level), "?")
+    log(f"  brownout level              {level:>8.0f}  ({level_name})")
+    log(f"  preemptions total           "
+        f"{gauges.get('qos_preemptions_total', 0.0):>8.0f}"
+        f"  ({gauges.get('qos_preempted_tokens_total', 0.0):.0f} tokens "
+        "carried)")
+    log(f"  queue expired total         "
+        f"{gauges.get('queue_expired_total', 0.0):>8.0f}")
+    log(f"  queue displaced total       "
+        f"{gauges.get('queue_displaced_total', 0.0):>8.0f}")
 
 
 async def http_probe(args) -> None:
