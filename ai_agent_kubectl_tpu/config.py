@@ -316,6 +316,23 @@ class ServiceConfig:
     # Flight recorder: keep the full span timeline of the last N requests
     # (including shed/degraded/errored) for /debug/requests lookups.
     flight_recorder_size: int = 256         # FLIGHT_RECORDER_SIZE
+    # Goodput ledger (obs/ledger.py): classify every device decode step
+    # delivered | replayed | preempted | hedge_loser | wasted_masked |
+    # quarantine_burn, per lane (metrics) and per hashed tenant
+    # (/debug/ledger only). false disables the accounting (the waste
+    # counters it mirrors keep working).
+    ledger_enable: bool = True              # LEDGER_ENABLE
+    # TTFT SLO target (ms) for the burn-rate engine (obs/slo.py): a
+    # finished request whose first token took longer than this breaches.
+    # 0 disables the TTFT slo (queue-wait burn still runs off
+    # SLO_INTERACTIVE_MS).
+    slo_ttft_ms: float = 5000.0             # SLO_TTFT_MS
+    # Burn-rate windows (seconds, ascending, at most 4 — each is a
+    # metric label value): the classic fast/slow multi-window pair.
+    slo_windows: str = "300,3600"           # SLO_WINDOWS
+    # Success-rate objective the error budget is priced from: at 0.99,
+    # 1% of samples may breach before burn rate 1.0.
+    slo_objective: float = 0.99             # SLO_OBJECTIVE
     # Debug-endpoint token: when set, /debug/* additionally requires
     # X-Debug-Token (profiler captures and request timelines are
     # operator-facing, not client-facing). Unset = only API-key auth
@@ -356,6 +373,16 @@ class ServiceConfig:
         # engine.protocol.)
         self.tenant_tier_map
         self.lane_weight_map
+        # SLO knobs (ISSUE 8): a typo'd window list or an objective
+        # outside (0,1) must refuse to boot, not serve meaningless burn
+        # rates.
+        self.slo_window_list
+        if not 0.0 < self.slo_objective < 1.0:
+            raise ValueError(
+                f"SLO_OBJECTIVE must be in (0, 1), got {self.slo_objective}")
+        if self.slo_ttft_ms < 0:
+            raise ValueError(
+                f"SLO_TTFT_MS must be >= 0, got {self.slo_ttft_ms}")
 
     @property
     def tenant_tier_map(self) -> dict:
@@ -372,6 +399,12 @@ class ServiceConfig:
         from .engine.qos import parse_lane_weights
 
         return parse_lane_weights(self.lane_weights)
+
+    @property
+    def slo_window_list(self) -> Tuple[int, ...]:
+        from .obs.slo import parse_slo_windows
+
+        return parse_slo_windows(self.slo_windows)
 
     @property
     def auth_enabled(self) -> bool:
@@ -452,6 +485,10 @@ class ServiceConfig:
             engine_reset_max_per_min=_env_int("ENGINE_RESET_MAX_PER_MIN", 12),
             fault_points=_env_str("FAULT_POINTS", "") or "",
             flight_recorder_size=_env_int("FLIGHT_RECORDER_SIZE", 256),
+            ledger_enable=_env_bool("LEDGER_ENABLE", True),
+            slo_ttft_ms=_env_float("SLO_TTFT_MS", 5000.0),
+            slo_windows=_env_str("SLO_WINDOWS", "300,3600") or "300,3600",
+            slo_objective=_env_float("SLO_OBJECTIVE", 0.99),
             debug_token=_env_str("DEBUG_TOKEN", None),
             drain_timeout_secs=_env_float("DRAIN_TIMEOUT_SECS", 10.0),
             compile_cache_dir=os.getenv(

@@ -47,6 +47,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import KVCache, forward
+from ..obs.ledger import (CLASS_DELIVERED, CLASS_HEDGE_LOSER,
+                          CLASS_PREEMPTED, CLASS_QUARANTINE_BURN,
+                          CLASS_REPLAYED, CLASS_WASTED_MASKED,
+                          GoodputLedger)
+from ..obs.slo import SLO_QUEUE_WAIT, SLO_TTFT, SloEngine
 from ..obs.trace import Trace, current_trace
 from ..ops.quant import (kv_broadcast_rows, kv_set_slots, kv_slot_update,
                          kv_tokens, kv_update_slice)
@@ -265,6 +270,25 @@ class _Request:
     preempt_count: int = 0
     preempt_t0: Optional[float] = None
     resume_skip: int = 0
+    # Goodput ledger (ISSUE 8): transcript tokens already billed as
+    # delivered for this request. A fleet-migrated import starts at
+    # len(resume_ids) — the donor replica decoded AND billed that
+    # prefix; this engine only bills what it decodes beyond it.
+    ledger_delivered: int = 0
+    # Why the next _replay_slot re-splice exists: "preempt" bills the
+    # re-derivation to the ledger's preempted class (QoS export/replay),
+    # anything else to replayed (containment reset / fleet migration).
+    # Cleared on every _replay_slot entry — early-return paths included
+    # — so a later unrelated containment replay bills replayed.
+    resume_cause: str = ""
+    # SLO accounting (ISSUE 8): monotonic stamp of the FIRST token this
+    # request ever delivered — survives preempt/resume (the slot's
+    # t_first resets with the slot), so a resumed request's TTFT sample
+    # reflects the client's real first byte. ttft_exempt marks fleet
+    # imports: their first byte happened on the donor replica, and a
+    # recipient-side sample would overstate.
+    t_first0: Optional[float] = None
+    ttft_exempt: bool = False
 
 
 @dataclasses.dataclass
@@ -316,6 +340,10 @@ class BatchedJaxEngine(JaxEngine):
                  preempt_wait_ms: float = 500.0,
                  preempt_budget: int = 2,
                  slo_interactive_ms: float = 0.0,
+                 ledger_enable: bool = True,
+                 slo_ttft_ms: float = 0.0,
+                 slo_windows: tuple = (300, 3600),
+                 slo_objective: float = 0.99,
                  faults=None,
                  **kwargs):
         super().__init__(*args, **kwargs)
@@ -387,6 +415,17 @@ class BatchedJaxEngine(JaxEngine):
         self.preempt_wait_ms = max(0.0, preempt_wait_ms)
         self.preempt_budget = max(0, preempt_budget)
         self._brownout = BrownoutController(slo_interactive_ms)
+        # Telemetry plane (ISSUE 8): the goodput ledger classifies every
+        # device decode step this engine burns (delivered vs the waste
+        # classes — obs/ledger.py), fed at the exact sites that already
+        # count those events; the SLO engine judges TTFT and queue-wait
+        # samples per lane against their targets and serves multi-window
+        # burn rates (obs/slo.py), which also feed the brownout
+        # controller as an early-trim signal.
+        self.ledger = GoodputLedger(enabled=ledger_enable)
+        self._slo = SloEngine(
+            {SLO_TTFT: slo_ttft_ms, SLO_QUEUE_WAIT: slo_interactive_ms},
+            objective=slo_objective, windows=tuple(slo_windows))
         self._preemptions = 0          # cumulative preempt-and-replay count
         self._preempted_tokens = 0     # generated tokens carried across them
         self._preempt_times: collections.deque = collections.deque(maxlen=512)
@@ -525,6 +564,10 @@ class BatchedJaxEngine(JaxEngine):
             preempt_wait_ms=cfg.preempt_wait_ms,
             preempt_budget=cfg.preempt_budget,
             slo_interactive_ms=cfg.slo_interactive_ms,
+            ledger_enable=cfg.ledger_enable,
+            slo_ttft_ms=cfg.slo_ttft_ms,
+            slo_windows=cfg.slo_window_list,
+            slo_objective=cfg.slo_objective,
             faults=faults,
         )
 
@@ -1177,6 +1220,11 @@ class BatchedJaxEngine(JaxEngine):
                         lane_shares={
                             k: round(v, 4)
                             for k, v in self._brownout.shares.items()}),
+            # Telemetry plane (ISSUE 8): goodput ledger lane table and
+            # SLO burn rates — delta-mirrored into Prometheus at scrape
+            # time (Metrics.observe_ledger / observe_slo). Pure reads.
+            "ledger": self.ledger.snapshot(),
+            "slo": self._slo.snapshot(),
         }
 
     #: finish timestamps older than this don't feed the drain-rate
@@ -1265,7 +1313,9 @@ class BatchedJaxEngine(JaxEngine):
                 # past PREEMPT_WAIT_MS with every slot busy exports the
                 # cheapest lower-lane victim, whose freed slot the
                 # _admit_pending call right below hands to that lane.
-                self._brownout.maybe_eval()
+                self._brownout.maybe_eval(
+                    burn_fn=lambda: self._slo.fast_burn(
+                        SLO_QUEUE_WAIT, LANE_INTERACTIVE))
                 self._maybe_preempt()
                 self._admit_pending()
                 self._sweep_finishes()
@@ -1469,6 +1519,13 @@ class BatchedJaxEngine(JaxEngine):
         for slot in quarantined:
             reason = reasons[id(slot)]
             self.supervisor.note_quarantine(reason)
+            # Ledger: everything this request generated is now discarded
+            # — its steps were burned, never delivered (a quarantine
+            # never reaches _finish, so nothing double-bills).
+            burn = len(slot.detok.ids) - slot.req.ledger_delivered
+            slot.req.ledger_delivered = len(slot.detok.ids)
+            self.ledger.record(CLASS_QUARANTINE_BURN, burn,
+                               lane=slot.req.lane, tenant=slot.req.tenant)
             if slot.req.trace is not None:
                 slot.req.trace.event(
                     f"engine: quarantined ({reason}, "
@@ -1564,6 +1621,10 @@ class BatchedJaxEngine(JaxEngine):
         bf16 matmul reduction reordering could flip a near-tie pick
         (same numerics class as the int8-KV argmax-flip xfail)."""
         req = slot.req
+        # Consume the resume cause at ENTRY — the early returns below
+        # must clear it too, or a preempted-then-cancelled request's
+        # later containment replay would misbill as preempted.
+        resume_cause, req.resume_cause = req.resume_cause, ""
         if req.cancel.is_set():
             return
         if req.deadline is not None and time.monotonic() > req.deadline:
@@ -1604,10 +1665,18 @@ class BatchedJaxEngine(JaxEngine):
         slot.exhausted = n_total >= self.max_seq_len
         self._slots[slot_idx] = slot
         self.supervisor.note_replay(g)
+        # Ledger: the g already-generated tokens are re-derived by the
+        # replay prefill — device work that produces no new client byte.
+        # Preemption resumes bill the preempted class; containment
+        # resets and fleet-migration imports bill replayed.
+        cls = (CLASS_PREEMPTED if resume_cause == "preempt"
+               else CLASS_REPLAYED)
+        self.ledger.record(cls, g, lane=req.lane, tenant=req.tenant)
         if req.trace is not None:
             req.trace.event(
                 f"engine: replayed into slot {slot_idx} from {g} "
                 f"generated tokens (seed {req.seed})")
+            req.trace.link("resumed", slot=slot_idx, tokens=g)
         self._last_admit_t = time.monotonic()
 
     def _supervise_scheduler(self) -> None:
@@ -1852,10 +1921,18 @@ class BatchedJaxEngine(JaxEngine):
             req.export.ids = list(ids)
         if (self.device_termination and slot.decode_chunks_inflight > 0):
             remaining = max(0, req.max_tokens - len(ids))
-            self._wasted_steps += min(
-                slot.decode_chunks_inflight * self.chunk_len, remaining)
+            self._bill_waste(min(
+                slot.decode_chunks_inflight * self.chunk_len, remaining),
+                req)
         self._preemptions += 1
         self._preempted_tokens += len(ids)
+        # Ledger billing happens at RESUME (_replay_slot, preempted
+        # class): the re-derivation prefill is the device work, and a
+        # victim cancelled while queued never pays it. No cause when
+        # nothing was generated — re-admission then takes the FRESH
+        # path (_admit_one), which never consumes the marker, and a
+        # stale one would misbill a later containment replay.
+        req.resume_cause = "preempt" if ids else ""
         self._preempt_times.append(req.preempt_t0)
         if req.trace is not None:
             req.trace.event(
@@ -1863,6 +1940,10 @@ class BatchedJaxEngine(JaxEngine):
                 f"(lane {req.lane} yields to starved lane {for_lane}; "
                 f"preemption {req.preempt_count}/{self.preempt_budget}) — "
                 f"exported for seeded replay")
+            # Causal span link: the stitched /debug/requests timeline
+            # joins this segment to the later resume by these links.
+            req.trace.link("preempted", from_slot=idx, tokens=len(ids),
+                           for_lane=for_lane, lane=req.lane)
         self._admissions.requeue_head(req)
 
     def _inject_flood(self, n: int, loop) -> None:
@@ -1913,6 +1994,35 @@ class BatchedJaxEngine(JaxEngine):
             "queue_expired_total": self._admissions.expired_total,
             "queue_displaced_total": self._admissions.displaced_total,
         }
+
+    # ------------------------------------------ telemetry plane (ISSUE 8)
+
+    def _bill_waste(self, n: int, req: Optional[_Request]) -> None:
+        """Bill ``n`` wasted device steps to BOTH the legacy counter
+        (wasted_decode_steps_total) and the goodput ledger's
+        wasted_masked class — one call site per waste event so the two
+        books can never drift apart."""
+        if n <= 0:
+            return
+        self._wasted_steps += n
+        lane = getattr(req, "lane", LANE_INTERACTIVE) if req is not None \
+            else LANE_INTERACTIVE
+        tenant = getattr(req, "tenant", None) if req is not None else None
+        self.ledger.record(CLASS_WASTED_MASKED, n, lane=lane, tenant=tenant)
+
+    def slo_health(self) -> dict:
+        """SLO burn-rate view for /health (obs/slo.py snapshot — pure
+        reads, never stats(), same rule as qos_health)."""
+        return self._slo.snapshot()
+
+    def ledger_snapshot(self) -> dict:
+        """Full goodput ledger for /debug/ledger: the lane table plus
+        the hashed-tenant table (debug-only by the cardinality rule)
+        and the conservation check."""
+        snap = self.ledger.snapshot()
+        snap["tenants"] = self.ledger.tenant_snapshot()
+        snap["conservation"] = self.ledger.conservation()
+        return snap
 
     def _admit_pending(self) -> None:
         """Admit every queued request that fits a free slot. Requests on
@@ -2183,8 +2293,9 @@ class BatchedJaxEngine(JaxEngine):
         prefix = self._prefix
         t_adm = time.monotonic()
         for req in live:
-            self._brownout.note_queue_wait(
-                req.lane, (t_adm - req.t_submit) * 1000.0, now=t_adm)
+            wait_ms = (t_adm - req.t_submit) * 1000.0
+            self._brownout.note_queue_wait(req.lane, wait_ms, now=t_adm)
+            self._slo.note(SLO_QUEUE_WAIT, req.lane, wait_ms, now=t_adm)
 
         # Suffix-depth scratch: kv_limit positions hold everything a
         # suffix admission writes (prefix.n + sbucket, tile-rounded); the
@@ -2278,8 +2389,9 @@ class BatchedJaxEngine(JaxEngine):
             return
         slot_idx = self._slots.index(None)
         t_adm = time.monotonic()
-        self._brownout.note_queue_wait(
-            req.lane, (t_adm - req.t_submit) * 1000.0, now=t_adm)
+        wait_ms = (t_adm - req.t_submit) * 1000.0
+        self._brownout.note_queue_wait(req.lane, wait_ms, now=t_adm)
+        self._slo.note(SLO_QUEUE_WAIT, req.lane, wait_ms, now=t_adm)
 
         last_logits, scratch, n_prompt, prefix_hit = self._prefill_prompt(
             req.prompt_ids, req.max_tokens
@@ -2395,6 +2507,8 @@ class BatchedJaxEngine(JaxEngine):
         slot.chunks_inflight -= 1
         now = time.monotonic()
         slot.t_first = now
+        if req.t_first0 is None:
+            req.t_first0 = now
         slot.t_decode0 = now
         slot.prefill_ms = (now - slot.t_admit) * 1000.0
         if req.trace is not None:
@@ -2609,8 +2723,9 @@ class BatchedJaxEngine(JaxEngine):
                 # with — the tail waste the done mask eliminates. (Device
                 # mode prices host-only finishes at _finish time instead;
                 # device-visible finishes froze inside the chunk.)
-                self._wasted_steps += sum(
-                    self.chunk_len for snap in entry[2] if snap is not None)
+                for snap in entry[2]:
+                    if snap is not None:
+                        self._bill_waste(self.chunk_len, snap)
             self._chunks_pruned += 1
             self._chunk_log.append({"t": time.time(), "event": "prune"})
 
@@ -2686,7 +2801,7 @@ class BatchedJaxEngine(JaxEngine):
                 # device termination the carry mask froze the slot, and
                 # host-only finishes are priced at _finish time instead).
                 if snapshot[i] is not None and not self.device_termination:
-                    self._wasted_steps += self.chunk_len
+                    self._bill_waste(self.chunk_len, snapshot[i])
                 continue
             slot.chunks_inflight -= 1
             slot.decode_chunks_inflight -= 1
@@ -2698,10 +2813,12 @@ class BatchedJaxEngine(JaxEngine):
                 new_ids, finish, wasted = scan_chunk_row(
                     res.tokens[i], len(slot.detok.ids), cfg.eos_ids,
                     slot.req.max_tokens)
-                self._wasted_steps += wasted
+                self._bill_waste(wasted, slot.req)
             if new_ids:
                 if slot.t_first is None:
                     slot.t_first = time.monotonic()
+                    if slot.req.t_first0 is None:
+                        slot.req.t_first0 = slot.t_first
                 t_dk = time.monotonic()
                 piece = slot.detok.push(*new_ids)
                 slot.detok_ms += (time.monotonic() - t_dk) * 1000.0
@@ -2772,8 +2889,9 @@ class BatchedJaxEngine(JaxEngine):
         if (wasted_inflight and self.device_termination
                 and slot.decode_chunks_inflight > 0):
             remaining = max(0, slot.req.max_tokens - len(slot.detok.ids))
-            self._wasted_steps += min(
-                slot.decode_chunks_inflight * self.chunk_len, remaining)
+            self._bill_waste(min(
+                slot.decode_chunks_inflight * self.chunk_len, remaining),
+                slot.req)
         # Any finish frees a slot — errors included — so all of them feed
         # the drain-rate estimate behind retry_after_hint(); the per-lane
         # deque prices Retry-After for THAT lane's sheds.
@@ -2782,6 +2900,24 @@ class BatchedJaxEngine(JaxEngine):
         lane = getattr(slot.req, "lane", LANE_INTERACTIVE)
         self._lane_finish.setdefault(
             lane, collections.deque(maxlen=64)).append(t_fin)
+        # Ledger: the emitted transcript is what the client's stream
+        # received — goodput, even when the request then errors (an
+        # abort/timeout client keeps its streamed bytes; quarantine is
+        # the exception and bills quarantine_burn in the containment
+        # pass, which never reaches _finish). Billed incrementally past
+        # ledger_delivered: a fleet-migrated request's imported prefix
+        # was decoded AND billed on the donor replica — re-billing it
+        # here would double-count the same device steps fleet-wide. A
+        # cancelled hedge-loser branch (export.discard, set by the
+        # fleet before the cancel) emitted tokens the relay never
+        # forwarded: hedge_loser burn, not delivered.
+        n_new = len(slot.detok.ids) - slot.req.ledger_delivered
+        slot.req.ledger_delivered = len(slot.detok.ids)
+        discarded = (slot.req.export is not None
+                     and getattr(slot.req.export, "discard", False))
+        self.ledger.record(
+            CLASS_HEDGE_LOSER if discarded else CLASS_DELIVERED,
+            n_new, lane=lane, tenant=slot.req.tenant)
         if error is not None:
             if slot.req.trace is not None:
                 slot.req.trace.event(
@@ -2795,6 +2931,18 @@ class BatchedJaxEngine(JaxEngine):
             self._emit(slot.req, "token", piece)
         t_end = time.monotonic()
         self._token_finishes.append((t_end, len(slot.detok.ids)))
+        if not slot.req.ttft_exempt and not discarded:
+            # t_first0 survives preempt/resume; the slot's t_first is a
+            # fresh slot's view and would overstate a resumed TTFT. A
+            # cancelled hedge loser contributes NO sample — the winner's
+            # finish already measures this logical request, and the
+            # loser's latency is exactly the stall the hedge papered
+            # over (the client never saw it).
+            self._slo.note(
+                SLO_TTFT, lane,
+                ((slot.req.t_first0 or slot.t_first or t_end)
+                 - slot.req.t_submit) * 1000.0,
+                now=t_end)
         if slot.req.trace is not None:
             slot.req.trace.event(
                 f"engine: finished ({finish}, "
@@ -2899,6 +3047,11 @@ class BatchedJaxEngine(JaxEngine):
             export=export,
             tenant=tenant,
             lane=lane,
+            # Fleet import: the resume prefix was decoded and billed
+            # delivered on the donor replica (see _Request.ledger_delivered),
+            # and the client's first byte happened there too.
+            ledger_delivered=len(resume_ids) if resume_ids else 0,
+            ttft_exempt=bool(resume_ids),
         )
         # Fair-share load shedding at submit time (QoSQueue policy):
         # past the per-tenant cap → 429 to the flooding tenant; past

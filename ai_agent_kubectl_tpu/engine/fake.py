@@ -25,6 +25,12 @@ from typing import AsyncIterator, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..obs.ledger import (CLASS_DELIVERED, CLASS_HEDGE_LOSER,
+                          CLASS_PREEMPTED, CLASS_QUARANTINE_BURN,
+                          CLASS_REPLAYED, CLASS_WASTED_MASKED,
+                          GoodputLedger)
+from ..obs.slo import SLO_QUEUE_WAIT, SLO_TTFT, SloEngine
+from ..obs.trace import current_trace
 from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
                           CAUSE_SLOT_HEALTH, PROBATION_CLEAN_CHUNKS,
                           REASON_HEALTH, REASON_ISOLATED, EngineSupervisor)
@@ -146,6 +152,21 @@ class _FakeReq:
     # suppression is whole-prefix; fleet migrations leave it False and
     # the relay suppresses by length instead).
     resume_emitted: bool = False
+    # Request-lifecycle trace (obs/trace.py), captured from the
+    # submitting coroutine's context — the fake runs on the event loop,
+    # so the same contextvar leg the batcher's async side uses works
+    # directly. Lets preempt/resume span links land on the stitched
+    # /debug/requests timeline in fake-engine tests too.
+    trace: Optional[object] = None
+    # Goodput ledger + SLO (ISSUE 8) — mirrors of the batcher's fields:
+    # tokens already billed delivered (fleet imports start at the prefix
+    # the donor billed), why the next resume re-splice exists ("preempt"
+    # bills preempted, else replayed), the first-token stamp that
+    # survives preempt/resume, and the fleet-import TTFT exemption.
+    ledger_delivered: int = 0
+    resume_cause: str = ""
+    t_first0: Optional[float] = None
+    ttft_exempt: bool = False
 
 
 @dataclasses.dataclass
@@ -157,6 +178,7 @@ class _FakeSlot:
     dev_active: bool              # device-resident live mask entry
     last_tok: int                 # device carry token (garbage repeats)
     decode_chunks_inflight: int = 0
+    t_first: Optional[float] = None   # first token emitted (TTFT SLO)
 
 
 class FakeChunkedEngine:
@@ -186,6 +208,10 @@ class FakeChunkedEngine:
                  preempt_wait_ms: float = 0.0,
                  preempt_budget: int = 2,
                  slo_interactive_ms: float = 0.0,
+                 ledger_enable: bool = True,
+                 slo_ttft_ms: float = 0.0,
+                 slo_windows: tuple = (300, 3600),
+                 slo_objective: float = 0.99,
                  faults=None,
                  stream_fn: Optional[Callable[[str], List[int]]] = None):
         if chunk_pipe_depth < 1:
@@ -208,6 +234,13 @@ class FakeChunkedEngine:
         self.preempt_wait_ms = max(0.0, preempt_wait_ms)
         self.preempt_budget = max(0, preempt_budget)
         self._brownout = BrownoutController(slo_interactive_ms)
+        # Telemetry plane (ISSUE 8) — same goodput ledger + SLO burn
+        # engine the batcher runs, over the fake's numpy state, so the
+        # conservation invariant is assertable in milliseconds.
+        self.ledger = GoodputLedger(enabled=ledger_enable)
+        self._slo = SloEngine(
+            {SLO_TTFT: slo_ttft_ms, SLO_QUEUE_WAIT: slo_interactive_ms},
+            objective=slo_objective, windows=tuple(slo_windows))
         self._preemptions = 0
         self._preempted_tokens = 0
         self._preempt_times: deque = deque(maxlen=512)
@@ -333,7 +366,31 @@ class FakeChunkedEngine:
             "containment": dict(self.supervisor.stats(),
                                 parked=len(self._parked),
                                 slot_health_check=self.slot_health_check),
+            "ledger": self.ledger.snapshot(),
+            "slo": self._slo.snapshot(),
         }
+
+    # ------------------------------------------ telemetry plane (ISSUE 8)
+
+    def _bill_waste(self, n: int, req: Optional[_FakeReq]) -> None:
+        """Mirror of the batcher's: one call site bills the legacy
+        wasted-steps counter AND the ledger's wasted_masked class."""
+        if n <= 0:
+            return
+        self._wasted_steps += n
+        lane = getattr(req, "lane", LANE_INTERACTIVE) if req is not None \
+            else LANE_INTERACTIVE
+        tenant = getattr(req, "tenant", None) if req is not None else None
+        self.ledger.record(CLASS_WASTED_MASKED, n, lane=lane, tenant=tenant)
+
+    def slo_health(self) -> dict:
+        return self._slo.snapshot()
+
+    def ledger_snapshot(self) -> dict:
+        snap = self.ledger.snapshot()
+        snap["tenants"] = self.ledger.tenant_snapshot()
+        snap["conservation"] = self.ledger.conservation()
+        return snap
 
     # ---------------------------------------------------------- scheduler
 
@@ -394,7 +451,9 @@ class FakeChunkedEngine:
         # QoS ring: brownout evaluation + preemptive decode (mirror of
         # the batcher's worker-loop placement — the freed slot is handed
         # to the starved lane by the _admit_pending call right below).
-        self._brownout.maybe_eval()
+        self._brownout.maybe_eval(
+            burn_fn=lambda: self._slo.fast_burn(
+                SLO_QUEUE_WAIT, LANE_INTERACTIVE))
         self._maybe_preempt()
         self._admit_pending()
         self._prune_dead_chunks()
@@ -489,15 +548,23 @@ class FakeChunkedEngine:
         req.preempt_t0 = time.monotonic()
         req.resume_ids = list(slot.emitted)
         req.resume_emitted = True    # fake pieces are always fully emitted
+        # Mirror the batcher: no cause marker when nothing was generated
+        # (the fresh re-admission path never consumes it).
+        req.resume_cause = "preempt" if slot.emitted else ""
         if req.export is not None:
             req.export.ids = list(slot.emitted)
         if self.device_termination and slot.decode_chunks_inflight > 0:
             remaining = max(0, req.max_tokens - len(slot.emitted))
-            self._wasted_steps += min(
-                slot.decode_chunks_inflight * self.chunk_len, remaining)
+            self._bill_waste(min(
+                slot.decode_chunks_inflight * self.chunk_len, remaining),
+                req)
         self._preemptions += 1
         self._preempted_tokens += len(slot.emitted)
         self._preempt_times.append(req.preempt_t0)
+        if req.trace is not None:
+            req.trace.link("preempted", from_slot=idx,
+                           tokens=len(slot.emitted), for_lane=for_lane,
+                           lane=req.lane)
         self._queue.requeue_head(req)
 
     def _inject_flood(self, n: int) -> None:
@@ -564,8 +631,13 @@ class FakeChunkedEngine:
             lane = req.lane if req.lane in LANES else LANE_INTERACTIVE
             counts[lane] += 1
             if req.t_submit:
-                self._brownout.note_queue_wait(
-                    lane, (time.monotonic() - req.t_submit) * 1000.0)
+                wait_ms = (time.monotonic() - req.t_submit) * 1000.0
+                self._brownout.note_queue_wait(lane, wait_ms)
+                # Mirror the batcher: resumes (preemption returns, fleet
+                # imports) are NOT fresh queue waits — their wall since
+                # t_submit includes time spent decoding.
+                if not req.resume_ids:
+                    self._slo.note(SLO_QUEUE_WAIT, lane, wait_ms)
             i = self._slots.index(None)
             if req.resume_ids:
                 # Cross-replica import (fleet migration) or preemption
@@ -580,13 +652,27 @@ class FakeChunkedEngine:
                     dev_ngen=g,
                     dev_active=(g < req.max_tokens
                                 if self.device_termination else True),
-                    last_tok=req.resume_ids[-1])
+                    last_tok=req.resume_ids[-1],
+                    t_first=time.monotonic())
                 if not req.resume_emitted:
                     req.out_queue.put_nowait(
                         ("token", self._piece(slot.emitted, 0)))
                 req.resume_emitted = True
                 if req.export is not None:
                     req.export.ids = list(slot.emitted)
+                # Ledger: the resume re-derives g tokens (mirror of the
+                # batcher's _replay_slot billing — preemption resumes
+                # bill preempted, migration imports bill replayed). A
+                # budget-spent import never re-splices, so it bills
+                # nothing — same as the batcher's early finish.
+                cls = (CLASS_PREEMPTED if req.resume_cause == "preempt"
+                       else CLASS_REPLAYED)
+                req.resume_cause = ""
+                if g < req.max_tokens:
+                    self.ledger.record(cls, g, lane=lane,
+                                       tenant=req.tenant)
+                    if req.trace is not None:
+                        req.trace.link("resumed", slot=i, tokens=g)
                 self._slots[i] = slot
                 if g >= req.max_tokens:
                     self._finish(i, "length")
@@ -601,7 +687,10 @@ class FakeChunkedEngine:
                 continue
             slot = _FakeSlot(req=req, emitted=[first], dev_idx=1,
                              dev_ngen=1, dev_active=req.max_tokens > 1,
-                             last_tok=first)
+                             last_tok=first,
+                             t_first=time.monotonic())
+            if req.t_first0 is None:
+                req.t_first0 = slot.t_first
             if not self.device_termination:
                 slot.dev_active = True
             self._slots[i] = slot
@@ -695,8 +784,9 @@ class FakeChunkedEngine:
             if not self.device_termination:
                 # Mirror the batcher: pruned legacy chunks executed a full
                 # chunk of garbage per dispatched slot.
-                self._wasted_steps += sum(
-                    self.chunk_len for snap in entry[2] if snap is not None)
+                for snap in entry[2]:
+                    if snap is not None:
+                        self._bill_waste(self.chunk_len, snap)
             self._chunks_pruned += 1
 
     def _consume_oldest(self) -> None:
@@ -727,7 +817,7 @@ class FakeChunkedEngine:
         for i, slot in enumerate(self._slots):
             if slot is None or slot.req is not snapshot[i]:
                 if snapshot[i] is not None and not self.device_termination:
-                    self._wasted_steps += self.chunk_len
+                    self._bill_waste(self.chunk_len, snapshot[i])
                 continue
             slot.decode_chunks_inflight -= 1
             if self.device_termination:
@@ -738,7 +828,7 @@ class FakeChunkedEngine:
                 new_ids, finish, wasted = scan_chunk_row(
                     res.tokens[i], len(slot.emitted), self.eos_ids,
                     slot.req.max_tokens)
-                self._wasted_steps += wasted
+                self._bill_waste(wasted, slot.req)
             if new_ids:
                 piece = self._piece(new_ids, len(slot.emitted))
                 slot.emitted.extend(new_ids)
@@ -777,10 +867,27 @@ class FakeChunkedEngine:
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 self._slots[i] = None
+                self._bill_delivered(slot.req, len(slot.emitted))
                 slot.req.out_queue.put_nowait(("error", error))
         for slot in self._parked:
+            self._bill_delivered(slot.req, len(slot.emitted))
             slot.req.out_queue.put_nowait(("error", error))
         self._parked.clear()
+
+    def _bill_delivered(self, req: _FakeReq, n_total: int) -> None:
+        """Bill the emitted transcript as delivered, incrementally past
+        what was already billed (a fleet import's prefix was billed by
+        the donor — see _FakeReq.ledger_delivered). A cancelled
+        hedge-loser branch (export.discard) emitted tokens the relay
+        never forwarded — hedge_loser burn, not delivered (mirror of
+        the batcher's _finish)."""
+        n_new = n_total - req.ledger_delivered
+        req.ledger_delivered = n_total
+        cls = (CLASS_HEDGE_LOSER
+               if (req.export is not None
+                   and getattr(req.export, "discard", False))
+               else CLASS_DELIVERED)
+        self.ledger.record(cls, n_new, lane=req.lane, tenant=req.tenant)
 
     def _contain_poisoned_step(self, cause: str, named=(),
                                error: Optional[BaseException] = None) -> None:
@@ -820,6 +927,12 @@ class FakeChunkedEngine:
         qset = {id(s) for s in quarantined}
         for slot in quarantined:
             self.supervisor.note_quarantine(reasons[id(slot)])
+            # Ledger: the quarantined transcript is discarded — burned,
+            # never delivered (mirror of the batcher).
+            burn = len(slot.emitted) - slot.req.ledger_delivered
+            slot.req.ledger_delivered = len(slot.emitted)
+            self.ledger.record(CLASS_QUARANTINE_BURN, burn,
+                               lane=slot.req.lane, tenant=slot.req.tenant)
             slot.req.out_queue.put_nowait(("error", RequestQuarantined(
                 f"request quarantined after poisoning {cause} "
                 f"{slot.req.suspect_count}x (retry budget "
@@ -875,6 +988,13 @@ class FakeChunkedEngine:
         slot.decode_chunks_inflight = 0
         self._slots[i] = slot
         self.supervisor.note_replay(g)
+        # Ledger: the containment replay re-derives the emitted prefix
+        # (the fake's cursors jump, but accounting mirrors the real
+        # engine's re-splice prefill).
+        self.ledger.record(CLASS_REPLAYED, g, lane=req.lane,
+                           tenant=req.tenant)
+        if req.trace is not None:
+            req.trace.link("resumed", slot=i, tokens=g)
 
     def _finish(self, slot_idx: int, finish: str,
                 error: Optional[BaseException] = None,
@@ -889,11 +1009,27 @@ class FakeChunkedEngine:
         if (wasted_inflight and self.device_termination
                 and slot.decode_chunks_inflight > 0):
             remaining = max(0, slot.req.max_tokens - len(slot.emitted))
-            self._wasted_steps += min(
-                slot.decode_chunks_inflight * self.chunk_len, remaining)
+            self._bill_waste(min(
+                slot.decode_chunks_inflight * self.chunk_len, remaining),
+                slot.req)
+        # Ledger + TTFT SLO (mirror of the batcher's _finish).
+        self._bill_delivered(slot.req, len(slot.emitted))
         if error is not None:
             slot.req.out_queue.put_nowait(("error", error))
             return
+        now = time.monotonic()
+        if (slot.req.t_submit and not slot.req.ttft_exempt
+                and not (slot.req.export is not None
+                         and getattr(slot.req.export, "discard", False))):
+            # t_first0 survives preempt/resume (mirror of the batcher);
+            # fleet imports are exempt — their first byte was the
+            # donor's.
+            self._slo.note(
+                SLO_TTFT, slot.req.lane if slot.req.lane in LANES
+                else LANE_INTERACTIVE,
+                ((slot.req.t_first0 or slot.t_first or now)
+                 - slot.req.t_submit) * 1000.0,
+                now=now)
         slot.req.out_queue.put_nowait(
             ("done", self._result(slot.req, slot.emitted, finish)))
 
@@ -966,6 +1102,12 @@ class FakeChunkedEngine:
             tenant=tenant,
             lane=lane,
             t_submit=now,
+            trace=current_trace(),
+            # Fleet import: the prefix was decoded and billed delivered
+            # on the donor replica (see _FakeReq.ledger_delivered), and
+            # the client's first byte happened there too.
+            ledger_delivered=len(resume_ids) if resume_ids else 0,
+            ttft_exempt=bool(resume_ids),
         )
         # put() raises TenantOverloaded (429) at the per-tenant cap and
         # EngineOverloaded when this tenant floods a full queue; a quiet

@@ -57,6 +57,9 @@ import zlib
 from collections import OrderedDict, deque
 from typing import AsyncIterator, Dict, List, Optional, Sequence, Set, Tuple
 
+from ..obs import ledger as obs_ledger
+from ..obs import slo as obs_slo
+from ..obs.ledger import CLASS_HEDGE_LOSER, GoodputLedger
 from ..obs.trace import current_trace
 from ..server.breaker import OPEN, CircuitBreaker
 from .protocol import (EngineOverloaded, EngineResult, EngineUnavailable,
@@ -250,6 +253,11 @@ class EngineFleet:
         self._ejects = 0
         self._rejoins = 0
         self._finish_times: deque = deque(maxlen=128)
+        # Goodput ledger (ISSUE 8): the fleet's OWN ledger holds the one
+        # class only the relay can see — hedge_loser steps, billed when
+        # a losing branch is cancelled. Replica engines bill everything
+        # else; ledger_snapshot()/stats() merge all of them.
+        self.ledger = GoodputLedger()
         # Inner ring → fleet ring: each replica supervisor's resets feed
         # that replica's breaker (a flapping replica leaves rotation even
         # while its own containment keeps recovering requests) and are
@@ -682,6 +690,10 @@ class EngineFleet:
                     trace.event(
                         f"fleet: migrating off replica {rep.idx} "
                         f"({len(export_ids)} tokens carried, drain/eject)")
+                    # Span link: the stitched timeline's replica handoff
+                    # — the destination's admit events follow it.
+                    trace.link("migrated", from_replica=rep.idx,
+                               tokens=len(export_ids), cause="drain_eject")
                 # Don't exclude by index: the nudged replica is already
                 # unroutable by STATE (draining/ejected), and the nudge
                 # may have hit a hedge branch — excluding the primary
@@ -713,6 +725,9 @@ class EngineFleet:
                     f"fleet: replica {rep.idx} failed mid-request "
                     f"({type(err).__name__}); migrating with "
                     f"{len(export_ids)} generated tokens")
+                trace.link("migrated", from_replica=rep.idx,
+                           tokens=len(export_ids),
+                           cause=type(err).__name__)
             logger.warning(
                 "fleet: migrating request off replica %d after %s "
                 "(%d generated tokens carried)", rep.idx,
@@ -785,6 +800,30 @@ class EngineFleet:
         def best_ids() -> List[int]:
             return list(max((b["export"].ids for b in branches), key=len))
 
+        def bill_loser(b: dict, cause: str) -> None:
+            """Flight recorder + goodput ledger for a losing hedge
+            branch. The BILLING itself happens engine-side: the
+            export's ``discard`` flag (set before the cancel) makes the
+            loser replica's finish path classify its emitted tokens as
+            hedge_loser instead of delivered — the engine knows the
+            request's tenant and would otherwise bill the same steps as
+            goodput the client never received. The fleet only bills its
+            own ledger for engines with no ledger at all, and leaves
+            the span link (with the cancel cause) so the loser no
+            longer vanishes from /debug/requests."""
+            if b.get("loser_billed"):
+                return
+            b["loser_billed"] = True
+            lost = len(b["export"].ids) - len(resume_ids or [])
+            if lost > 0 and getattr(b["rep"].engine, "ledger",
+                                    None) is None:
+                self.ledger.record(CLASS_HEDGE_LOSER, lost,
+                                   lane=flight.lane)
+            trace = current_trace()
+            if trace is not None:
+                trace.link("hedge_loser", replica=b["rep"].idx,
+                           tokens=max(0, lost), cause=cause)
+
         launch(rep)
         winner: Optional[int] = None
         try:
@@ -815,6 +854,8 @@ class EngineFleet:
                                 f"fleet: hedging onto replica {alt.idx} "
                                 f"(no event within {self.hedge_ms:.0f}ms "
                                 f"from replica {rep.idx})")
+                            trace.link("hedge", primary=rep.idx,
+                                       hedge=alt.idx)
                         launch(alt)
                     continue
                 tag, kind, val = item
@@ -828,7 +869,12 @@ class EngineFleet:
                         self._hedge_wins += 1
                     for j, other in enumerate(branches):
                         if j != tag:
+                            # Flag BEFORE the cancel: the loser engine's
+                            # abort-finish must see it and bill these
+                            # tokens as hedge_loser, not delivered.
+                            other["export"].discard = True
                             await close_branch(other)
+                            bill_loser(other, "lost_race")
                 if winner is not None and tag != winner:
                     continue
                 if kind == "ev":
@@ -873,8 +919,14 @@ class EngineFleet:
                     await mig_task
                 except (asyncio.CancelledError, Exception):
                     pass
-            for b in branches:
+            for j, b in enumerate(branches):
+                # A branch raced past the winner decision (or the caller
+                # tore the attempt down mid-race): still a loser.
+                if winner is not None and j != winner:
+                    b["export"].discard = True
                 await close_branch(b)
+                if winner is not None and j != winner:
+                    bill_loser(b, "cancelled")
 
     @staticmethod
     async def _migrate_sentinel(flight: _Flight, q: asyncio.Queue) -> None:
@@ -955,6 +1007,65 @@ class EngineFleet:
                       "queue_expired_total", "queue_displaced_total"):
                 agg[k] += q.get(k, 0)
         return agg if seen else {}
+
+    def slo_health(self) -> dict:
+        """Fleet rollup of the replicas' SLO burn snapshots: per-window
+        counts sum, burn rates recompute from the sums (rates don't
+        average) — obs/slo.py merge_snapshots."""
+        snaps = []
+        for rep in self.replicas:
+            fn = getattr(rep.engine, "slo_health", None)
+            if not callable(fn):
+                continue
+            try:
+                snaps.append(fn() or {})
+            except Exception:   # pragma: no cover - stopped replica
+                continue
+        return obs_slo.merge_snapshots(snaps)
+
+    def ledger_snapshot(self) -> dict:
+        """Fleet goodput ledger for /debug/ledger: replica lane tables
+        merged with the relay's own hedge-loser ledger, hashed-tenant
+        tables summed, conservation re-checked on the merged books."""
+        snaps, tenants, conserv = [], {}, []
+        for rep in self.replicas:
+            fn = getattr(rep.engine, "ledger_snapshot", None)
+            if not callable(fn):
+                continue
+            try:
+                s = fn() or {}
+            except Exception:   # pragma: no cover - stopped replica
+                continue
+            for t, row in (s.pop("tenants", None) or {}).items():
+                dst = tenants.setdefault(
+                    t, {cls: 0 for cls in obs_ledger.LEDGER_CLASSES})
+                for cls in obs_ledger.LEDGER_CLASSES:
+                    dst[cls] += int(row.get(cls, 0))
+            c = s.pop("conservation", None)
+            if c:
+                conserv.append(c)
+            snaps.append(s)
+        own = self.ledger.snapshot()
+        for t, row in self.ledger.tenant_snapshot().items():
+            dst = tenants.setdefault(
+                t, {cls: 0 for cls in obs_ledger.LEDGER_CLASSES})
+            for cls in obs_ledger.LEDGER_CLASSES:
+                dst[cls] += int(row.get(cls, 0))
+        conserv.append(self.ledger.conservation())
+        snaps.append(own)
+        merged = obs_ledger.merge_snapshots(snaps)
+        merged["tenants"] = {
+            t: obs_ledger.GoodputLedger._derive(row)
+            for t, row in sorted(tenants.items())}
+        total = sum(c.get("total_steps", 0) for c in conserv)
+        accounted = sum(c.get("accounted", 0) for c in conserv)
+        merged["conservation"] = {
+            "total_steps": total,
+            "accounted": accounted,
+            "balanced": (accounted == total
+                         and all(c.get("balanced") for c in conserv)),
+        }
+        return merged
 
     def fleet_health(self) -> dict:
         """Cheap per-replica health view for /health (never calls
@@ -1089,6 +1200,16 @@ class EngineFleet:
                                         q.get("brownout_level", 0))
         if have_qos:
             agg["qos"] = qos
+        # Telemetry plane (ISSUE 8): lane-table ledgers merge (replicas
+        # + the relay's hedge-loser ledger); SLO burn windows merge by
+        # summed counts.
+        led = [s["ledger"] for s in replica_stats if s.get("ledger")]
+        if led:
+            agg["ledger"] = obs_ledger.merge_snapshots(
+                led + [self.ledger.snapshot()])
+        slo = [s["slo"] for s in replica_stats if s.get("slo")]
+        if slo:
+            agg["slo"] = obs_slo.merge_snapshots(slo)
         fleet = self.fleet_health()
         fleet["replicas"] = per_replica
         agg["fleet"] = fleet

@@ -238,6 +238,11 @@ class RequestExport:
     slots."""
 
     ids: List[int] = field(default_factory=list)
+    #: set by the fleet BEFORE cancelling a losing hedge branch: tokens
+    #: this dispatch emitted were never forwarded to the client (the
+    #: winning branch's bytes were), so the engine's finish accounting
+    #: must bill them as hedge_loser burn, not delivered goodput.
+    discard: bool = False
 
 
 @dataclass

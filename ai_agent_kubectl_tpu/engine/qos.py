@@ -585,9 +585,20 @@ class BrownoutController:
             return None
         return vals[min(len(vals) - 1, int(round(0.95 * (len(vals) - 1))))]
 
-    def maybe_eval(self, now: Optional[float] = None) -> bool:
+    def maybe_eval(self, now: Optional[float] = None,
+                   burn_fn: Optional[Callable[[], Optional[float]]] = None
+                   ) -> bool:
         """Time-gated AIMD step; returns True when the shares changed.
-        Called from the scheduler loop — cheap when gated out."""
+        Called from the scheduler loop — cheap when gated out.
+
+        ``burn_fn`` is the SLO burn-rate input (obs/slo.py): evaluated
+        only when the time gate passes, a fast-window burn rate > 1.0
+        for interactive queue wait counts as a breach even while the
+        raw p95 still sits under the threshold — the budget is being
+        eaten faster than the objective allows, which is exactly when
+        trimming bulk lanes early is cheaper than paging later. None
+        (or a burn_fn returning None — no samples) keeps the classic
+        p95-only behaviour."""
         if self.slo_ms <= 0:
             return False
         now = time.monotonic() if now is None else now
@@ -595,8 +606,10 @@ class BrownoutController:
             return False
         self._last_eval = now
         p95 = self._p95_locked(now)
+        burn = burn_fn() if burn_fn is not None else None
         before = dict(self.shares)
-        if p95 is not None and p95 > self.slo_ms:
+        if (p95 is not None and p95 > self.slo_ms) or (
+                burn is not None and burn > 1.0):
             if self.shares[LANE_BACKGROUND] > self.FLOOR:
                 self.shares[LANE_BACKGROUND] = max(
                     self.FLOOR, self.shares[LANE_BACKGROUND] * self.DECREASE)

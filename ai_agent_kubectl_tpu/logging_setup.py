@@ -16,11 +16,18 @@ import datetime
 import json
 import logging
 
+from .engine.qos import current_qos
+from .obs.ledger import hash_tenant
 from .obs.trace import current_trace
 
 
 class RequestIdFilter(logging.Filter):
-    """Stamp every record with the active request's ID (or None).
+    """Stamp every record with the active request's ID (or None), plus
+    its QoS classification: the lane verbatim (a closed three-value
+    set) and the tenant HASHED (obs/ledger.py hash_tenant — the raw key
+    may be an API key, and the hash is the same form the goodput
+    ledger's /debug/ledger tenant table uses, so a log grep and a
+    ledger row join on one opaque key).
 
     A Filter rather than a Formatter concern so ``record.request_id``
     exists even for records a third-party formatter renders."""
@@ -28,6 +35,10 @@ class RequestIdFilter(logging.Filter):
     def filter(self, record: logging.LogRecord) -> bool:
         trace = current_trace()
         record.request_id = trace.request_id if trace is not None else None
+        qctx = current_qos()
+        record.tenant = hash_tenant(qctx.tenant) if qctx is not None \
+            else None
+        record.lane = qctx.lane if qctx is not None else None
         return True
 
 
@@ -43,6 +54,10 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "message": record.getMessage(),
             "request_id": getattr(record, "request_id", None),
+            # QoS classification (ISSUE 8): hashed tenant + lane, so log
+            # lines join against the goodput ledger's tenant table.
+            "tenant": getattr(record, "tenant", None),
+            "lane": getattr(record, "lane", None),
         }
         if record.exc_info:
             entry["exc_info"] = self.formatException(record.exc_info)

@@ -16,18 +16,37 @@ import jax, aiohttp, or prometheus_client. Three pieces:
 - ``obs.profiler`` — on-demand ``jax.profiler`` device-trace capture for
   ``POST /debug/profile`` (token-gated), so a TPU trace can be grabbed
   from a live server without restarting it.
+- ``obs.ledger`` — the goodput ledger: every device decode step a
+  request cost, classified ``delivered | replayed | preempted |
+  hedge_loser | wasted_masked | quarantine_burn`` per lane (and per
+  hashed tenant behind ``/debug/ledger`` only), with a conservation
+  invariant the chaos suite asserts.
+- ``obs.slo`` — multi-window (5m/1h) error-budget burn rates for TTFT
+  and queue wait per lane, exported as ``slo_*`` gauges and a ``/health``
+  section, and consumable by the QoS brownout controller.
 """
 
+from .ledger import (LEDGER_CLASSES, WASTE_CLASSES, GoodputLedger,
+                     hash_tenant)
 from .recorder import FlightRecorder
+from .slo import SLO_QUEUE_WAIT, SLO_TTFT, SloEngine, parse_slo_windows
 from .trace import (PHASES, Trace, current_trace, new_request_id,
                     sanitize_request_id, trace_event, use_trace)
 
 __all__ = [
     "PHASES",
+    "LEDGER_CLASSES",
+    "WASTE_CLASSES",
+    "SLO_QUEUE_WAIT",
+    "SLO_TTFT",
     "FlightRecorder",
+    "GoodputLedger",
+    "SloEngine",
     "Trace",
     "current_trace",
+    "hash_tenant",
     "new_request_id",
+    "parse_slo_windows",
     "sanitize_request_id",
     "trace_event",
     "use_trace",

@@ -51,8 +51,10 @@ class FlightRecorder:
         if limit is not None:
             entries = entries[: max(0, int(limit))]
         return [
-            {k: v for k, v in e.items() if k not in ("spans", "events")}
-            | {"n_spans": len(e.get("spans", ()))}
+            {k: v for k, v in e.items()
+             if k not in ("spans", "events", "links")}
+            | {"n_spans": len(e.get("spans", ())),
+               "n_links": len(e.get("links", ()))}
             for e in entries
         ]
 

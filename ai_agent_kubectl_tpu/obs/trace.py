@@ -101,6 +101,7 @@ class Trace:
         self._t_end: Optional[float] = None
         self._spans: List[Span] = []
         self._events: List[tuple] = []
+        self._links: List[tuple] = []
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ recording
@@ -124,6 +125,19 @@ class Trace:
         """Point-in-time annotation; safe from any thread."""
         with self._lock:
             self._events.append((time.monotonic(), message, meta or None))
+
+    def link(self, link_type: str, **meta) -> None:
+        """Causal span link: a handoff where this request's execution
+        moved — preempted out of a slot, migrated off a replica, raced
+        on a hedge branch, a loser branch cancelled. Links are what
+        stitch ONE timeline out of a request that crossed scheduler
+        boundaries: every engine annotates the same Trace object (same
+        process, same monotonic clock, so offsets reconcile for free),
+        and the links name which segment each stretch of events belongs
+        to — including branches that lost and would otherwise vanish.
+        Safe from any thread, like ``event``."""
+        with self._lock:
+            self._links.append((time.monotonic(), link_type, meta or None))
 
     def finish(self, status: Optional[int] = None,
                error: Optional[str] = None) -> None:
@@ -192,9 +206,18 @@ class Trace:
                 }
                 for t, msg, meta in self._events
             ]
+            links = [
+                {
+                    "offset_ms": round((t - self.t0) * 1000.0, 3),
+                    "type": link_type,
+                    **({"meta": meta} if meta else {}),
+                }
+                for t, link_type, meta in self._links
+            ]
         d = self.summary()
         d["spans"] = spans
         d["events"] = events
+        d["links"] = links
         return d
 
 

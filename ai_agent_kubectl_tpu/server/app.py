@@ -878,6 +878,12 @@ async def handle_health(request: web.Request) -> web.Response:
     qh = getattr(svc.engine, "qos_health", None)
     if callable(qh):
         qos = qh() or None
+    # SLO burn rates (ISSUE 8): multi-window error-budget view — cheap
+    # (a bounded-deque scan, never stats()), same rule as qos/fleet.
+    slo = None
+    sh = getattr(svc.engine, "slo_health", None)
+    if callable(sh):
+        slo = sh() or None
     body = HealthResponse(
         status="healthy" if ready and breaker == "closed" else "degraded",
         engine=getattr(svc.engine, "name", "unknown"),
@@ -890,6 +896,7 @@ async def handle_health(request: web.Request) -> web.Response:
         last_reset_cause=last_cause,
         fleet=fleet,
         qos=qos,
+        slo=slo,
     )
     # The HTTP status tracks engine readiness alone: an open breaker with
     # the engine process alive still serves (fallback and/or cache), and
@@ -1025,6 +1032,27 @@ async def handle_debug_chunks(request: web.Request) -> web.Response:
     })
 
 
+async def handle_debug_ledger(request: web.Request) -> web.Response:
+    """GET /debug/ledger — the goodput ledger (obs/ledger.py): every
+    device decode step classified delivered vs the waste classes, per
+    lane AND per (hashed) tenant, with the conservation check. The
+    tenant breakdown lives here and only here — tenants must never
+    become metric labels (cardinality), and the keys are sha256 hashes
+    (they may be API keys), the same form LOG_FORMAT=json stamps on log
+    lines so the two surfaces join."""
+    denied = _debug_forbidden(request)
+    if denied is not None:
+        return denied
+    svc: Service = request.app["service"]
+    fn = getattr(svc.engine, "ledger_snapshot", None)
+    snap = fn() if callable(fn) else None
+    if not snap:   # absent, or a wrapper forwarding to an engine without one
+        return _json_error(
+            404, "engine exposes no goodput ledger (telemetry plane is "
+                 "wired into the chunked schedulers and the fleet)")
+    return web.json_response(snap)
+
+
 async def handle_metrics(request: web.Request) -> web.Response:
     svc: Service = request.app["service"]
     # Engine gauges are sampled at scrape time (live scheduler state, not a
@@ -1051,6 +1079,12 @@ async def handle_metrics(request: web.Request) -> web.Response:
         # preemption/expiry/displacement counters + brownout level.
         if stats.get("qos"):
             svc.metrics.observe_qos(stats["qos"])
+        # Telemetry plane (ISSUE 8): goodput ledger lane table +
+        # SLO burn-rate gauges — same delta-mirror pattern.
+        if stats.get("ledger"):
+            svc.metrics.observe_ledger(stats["ledger"])
+        if stats.get("slo"):
+            svc.metrics.observe_slo(stats["slo"])
     # Windowed throughput gauge: the batcher's own scheduler-side window
     # when it reports one (counts every finish, including streams), else
     # the service-side window fed by the response handlers.
@@ -1080,6 +1114,7 @@ def create_app(cfg: ServiceConfig, engine: Engine,
     app.router.add_get("/debug/requests", handle_debug_requests)
     app.router.add_get("/debug/requests/{id}", handle_debug_request_detail)
     app.router.add_get("/debug/chunks", handle_debug_chunks)
+    app.router.add_get("/debug/ledger", handle_debug_ledger)
     app.router.add_get("/health", handle_health)
     app.router.add_get("/metrics", handle_metrics)
     # /openapi.json + /docs — unauthenticated like the reference's

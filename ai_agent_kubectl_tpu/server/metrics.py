@@ -366,6 +366,54 @@ class Metrics:
         self._qos_seen = {"preemptions": 0, "preempted_tokens": 0,
                           "expired": 0, "displaced": 0}
 
+        # Goodput ledger (ISSUE 8, obs/ledger.py): every device decode
+        # step classified delivered | replayed | preempted | hedge_loser
+        # | wasted_masked | quarantine_burn, per priority lane. Both
+        # label sets are closed (three lanes, six classes) so
+        # cardinality is bounded by construction; tenants are
+        # deliberately NEVER labels — the per-tenant breakdown lives
+        # behind /debug/ledger only. Delta-mirrored from
+        # stats()["ledger"] like the pipeline/containment totals.
+        self.goodput_steps = Counter(
+            "goodput_steps_total",
+            "Device decode steps by accounting class and lane "
+            "(delivered = goodput; the rest are waste classes)",
+            ["lane", "class"],
+            registry=r,
+        )
+        self.goodput_ratio = Gauge(
+            "goodput_ratio",
+            "Delivered fraction of all accounted device steps, by lane",
+            ["lane"],
+            registry=r,
+        )
+        self._ledger_seen: dict = {}
+
+        # SLO burn-rate engine (ISSUE 8, obs/slo.py): multi-window
+        # error-budget burn for TTFT and queue wait per lane. ``slo``
+        # and ``lane`` are closed sets; ``window`` values come from
+        # SLO_WINDOWS, validated to at most obs.slo.MAX_WINDOWS at boot.
+        self.slo_burn_rate = Gauge(
+            "slo_burn_rate",
+            "Error-budget burn rate over the window (1.0 = spending "
+            "exactly at the objective's sustainable rate)",
+            ["slo", "lane", "window"],
+            registry=r,
+        )
+        self.slo_budget_remaining = Gauge(
+            "slo_error_budget_remaining",
+            "Unspent fraction of the window's error budget (floor 0)",
+            ["slo", "lane", "window"],
+            registry=r,
+        )
+        self.slo_breaches = Counter(
+            "slo_breaches_total",
+            "Latency samples that breached their SLO target",
+            ["slo", "lane"],
+            registry=r,
+        )
+        self._slo_seen: dict = {}
+
         # Request-lifecycle phase attribution (obs/trace.py): where a
         # request's wall time went. The ``phase`` label is drawn from the
         # fixed obs.PHASES allowlist — cardinality is bounded by
@@ -477,6 +525,46 @@ class Metrics:
             if total > seen[key]:
                 counter.inc(total - seen[key])
                 seen[key] = total
+
+    def observe_ledger(self, ledger: dict) -> None:
+        """Mirror the goodput ledger's lane table (stats()["ledger"])
+        into Prometheus at scrape time — per-(lane, class) cumulative
+        totals delta-inc'd, the per-lane goodput ratio set directly."""
+        from ..obs.ledger import LEDGER_CLASSES
+
+        for lane, row in (ledger.get("lanes") or {}).items():
+            seen = self._ledger_seen.setdefault(lane, {})
+            for cls in LEDGER_CLASSES:
+                total = row.get(cls, 0)
+                prev = seen.get(cls, 0)
+                if total > prev:
+                    # positional labels: "class" is a Python keyword.
+                    self.goodput_steps.labels(lane, cls).inc(total - prev)
+                    seen[cls] = total
+            lane_total = row.get("total", 0)
+            if lane_total:
+                self.goodput_ratio.labels(lane=lane).set(
+                    row.get("delivered", 0) / lane_total)
+
+    def observe_slo(self, slo: dict) -> None:
+        """Mirror the SLO burn snapshot (stats()["slo"]) into
+        Prometheus: per-window burn/budget gauges set directly,
+        cumulative breach counts delta-inc'd."""
+        for name, body in (slo.get("slos") or {}).items():
+            for lane, row in (body.get("lanes") or {}).items():
+                for window, win in (row.get("windows") or {}).items():
+                    self.slo_burn_rate.labels(
+                        slo=name, lane=lane, window=window).set(
+                        win.get("burn_rate", 0.0))
+                    self.slo_budget_remaining.labels(
+                        slo=name, lane=lane, window=window).set(
+                        win.get("budget_remaining", 1.0))
+                total = row.get("breaches_total", 0)
+                prev = self._slo_seen.get((name, lane), 0)
+                if total > prev:
+                    self.slo_breaches.labels(slo=name, lane=lane).inc(
+                        total - prev)
+                    self._slo_seen[(name, lane)] = total
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -259,6 +259,28 @@ def build_openapi() -> Dict:
                 "403": _err("Invalid or missing X-Debug-Token"),
             },
         }},
+        "/debug/ledger": {"get": {
+            "summary": "Goodput ledger: device decode steps classified "
+                       "delivered vs waste, per lane and hashed tenant",
+            "description": "Every device step the engine burned, "
+                           "classified delivered | replayed | preempted "
+                           "| hedge_loser | wasted_masked | "
+                           "quarantine_burn, with per-lane goodput "
+                           "percentages, the per-tenant table (keys are "
+                           "sha256 hashes — tenant keys may be API "
+                           "keys), and the conservation check "
+                           "(delivered + all waste classes == total "
+                           "accounted steps). Same auth/token gating "
+                           "as /debug/profile.",
+            "responses": {
+                "200": {"description": "{classes, lanes, tenants, "
+                                       "total_steps, goodput_pct, "
+                                       "conservation: {balanced, ...}}"},
+                "401": auth_err,
+                "403": _err("Invalid or missing X-Debug-Token"),
+                "404": _err("Engine exposes no goodput ledger"),
+            },
+        }},
     }
 
     return {

@@ -100,3 +100,8 @@ class HealthResponse(BaseModel):
     # minute, and scan-time expiry/displacement totals. None = engine
     # without the QoS scheduler (fake/openai single-sequence paths).
     qos: Optional[Dict[str, Any]] = None
+    # SLO burn-rate engine (obs/slo.py, ISSUE 8): multi-window (5m/1h)
+    # error-budget burn for TTFT and queue wait per lane, against the
+    # SLO_TTFT_MS / SLO_INTERACTIVE_MS targets. None = engine without
+    # the telemetry plane.
+    slo: Optional[Dict[str, Any]] = None

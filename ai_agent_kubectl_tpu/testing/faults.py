@@ -492,6 +492,16 @@ class ChaosEngine:
         fn = getattr(self.inner, "qos_health", None)
         return fn() if callable(fn) else {}
 
+    def slo_health(self) -> dict:
+        """Forward the SLO burn-rate /health section (ISSUE 8)."""
+        fn = getattr(self.inner, "slo_health", None)
+        return fn() if callable(fn) else {}
+
+    def ledger_snapshot(self) -> dict:
+        """Forward the goodput ledger (/debug/ledger, ISSUE 8)."""
+        fn = getattr(self.inner, "ledger_snapshot", None)
+        return fn() if callable(fn) else {}
+
     def set_reset_listener(self, fn) -> None:
         """Forward the containment reset→breaker hookup to the wrapped
         engine (the supervisor lives below this wrapper)."""

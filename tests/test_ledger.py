@@ -1,0 +1,657 @@
+"""Telemetry plane (ISSUE 8): goodput ledger, SLO burn-rate engine,
+cross-replica stitched timelines, and their HTTP/metrics surfaces.
+
+The standing invariants:
+
+- Ledger conservation: delivered + replayed + preempted + hedge_loser +
+  wasted_masked + quarantine_burn == total accounted steps — exact on
+  the fake engine under the decode:nan, tenant:flood, and scheduler:die
+  chaos drills, with delivered matching the tokens clients actually
+  received.
+- /metrics cardinality stays bounded with many distinct tenants active:
+  lanes and classes are labels, tenants never are; the per-tenant
+  breakdown lives behind /debug/ledger only, keyed by sha256 hashes.
+- A request that is preempted and then migrated off a killed replica
+  yields ONE stitched causal timeline (span links) on its trace.
+- SLO burn rates: multi-window error-budget math, /health section,
+  slo_* gauges, and the brownout controller consuming the fast-window
+  burn as an input signal.
+"""
+
+import asyncio
+import json
+import logging
+import time
+
+import pytest
+
+from ai_agent_kubectl_tpu.engine.fake import FakeChunkedEngine, FakeEngine
+from ai_agent_kubectl_tpu.engine.protocol import RequestQuarantined
+from ai_agent_kubectl_tpu.engine.qos import (LANE_BACKGROUND,
+                                             LANE_INTERACTIVE,
+                                             BrownoutController, QoSContext,
+                                             use_qos)
+from ai_agent_kubectl_tpu.obs.ledger import (LEDGER_CLASSES, GoodputLedger,
+                                             hash_tenant, merge_snapshots)
+from ai_agent_kubectl_tpu.obs.slo import (SloEngine, parse_slo_windows,
+                                          window_label)
+from ai_agent_kubectl_tpu.obs.slo import merge_snapshots as merge_slo
+from ai_agent_kubectl_tpu.obs.trace import Trace, use_trace
+from ai_agent_kubectl_tpu.testing.faults import FaultInjector
+
+# ---------------------------------------------------------------------------
+# GoodputLedger units
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_classes_conservation_and_goodput():
+    led = GoodputLedger()
+    led.record("delivered", 8, lane="interactive", tenant="key-a")
+    led.record("wasted_masked", 2, lane="interactive", tenant="key-a")
+    led.record("replayed", 3, lane="background", tenant="key-b")
+    led.record("preempted", 1, lane="background", tenant="key-b")
+    snap = led.snapshot()
+    assert snap["total_steps"] == 14
+    assert snap["classes"]["delivered"] == 8
+    assert snap["lanes"]["interactive"]["total"] == 10
+    assert snap["lanes"]["interactive"]["goodput_pct"] == 80.0
+    assert snap["lanes"]["background"]["goodput_pct"] == 0.0
+    c = led.conservation()
+    assert c["balanced"] and c["accounted"] == c["total_steps"] == 14
+    # Unknown classes are programming errors, not new label values.
+    with pytest.raises(ValueError):
+        led.record("mystery", 1)
+    # n <= 0 and disabled ledgers record nothing.
+    led.record("delivered", 0)
+    off = GoodputLedger(enabled=False)
+    off.record("delivered", 5)
+    assert off.snapshot()["total_steps"] == 0
+
+
+def test_ledger_tenant_table_hashed_and_bounded():
+    led = GoodputLedger(max_tenants=2)
+    for i in range(5):
+        led.record("delivered", 1, tenant=f"secret-key-{i}")
+    tenants = led.tenant_snapshot()
+    # 2 real entries + the overflow bucket; raw keys never appear.
+    assert len(tenants) == 3 and "~overflow" in tenants
+    assert all(k == "~overflow" or (len(k) == 12
+                                    and all(c in "0123456789abcdef"
+                                            for c in k))
+               for k in tenants)
+    assert not any("secret-key" in k for k in tenants)
+    assert tenants["~overflow"]["delivered"] == 3
+    # The hash is stable and equals what the log stamper produces.
+    assert hash_tenant("secret-key-0") in tenants
+    assert hash_tenant("secret-key-0") == hash_tenant("secret-key-0")
+    assert hash_tenant(None) == hash_tenant("anon")
+
+
+def test_ledger_merge_snapshots():
+    a, b = GoodputLedger(), GoodputLedger()
+    a.record("delivered", 5, lane="interactive")
+    a.record("wasted_masked", 5, lane="interactive")
+    b.record("delivered", 10, lane="interactive")
+    b.record("hedge_loser", 4, lane="batch")
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    assert merged["total_steps"] == 24
+    assert merged["lanes"]["interactive"]["delivered"] == 15
+    assert merged["lanes"]["interactive"]["goodput_pct"] == 75.0
+    assert merged["lanes"]["batch"]["hedge_loser"] == 4
+    assert merged["classes"]["delivered"] == 15
+
+
+# ---------------------------------------------------------------------------
+# SloEngine units
+# ---------------------------------------------------------------------------
+
+
+def test_parse_slo_windows_and_labels():
+    assert parse_slo_windows("300,3600") == (300, 3600)
+    assert window_label(300) == "5m" and window_label(3600) == "1h"
+    assert window_label(90) == "90s"
+    for bad in ("", "0", "3600,300", "300,300", "1,2,3,4,5", "-5"):
+        with pytest.raises(ValueError):
+            parse_slo_windows(bad)
+
+
+def test_slo_engine_burn_math_and_windows():
+    eng = SloEngine({"ttft": 100.0}, objective=0.9, windows=(10, 100))
+    t0 = 1000.0
+    # 8 good + 2 breaching inside the 10s window; 10 older good samples
+    # only inside the 100s window.
+    for i in range(10):
+        eng.note("ttft", "interactive", 50.0, now=t0 - 50.0 + i * 0.1)
+    for i in range(8):
+        eng.note("ttft", "interactive", 50.0, now=t0 - 5.0 + i * 0.1)
+    for i in range(2):
+        eng.note("ttft", "interactive", 500.0, now=t0 - 1.0 + i * 0.1)
+    snap = eng.snapshot(now=t0)
+    lanes = snap["slos"]["ttft"]["lanes"]["interactive"]
+    fast = lanes["windows"]["10s"]
+    slow = lanes["windows"]["100s"]
+    assert fast["total"] == 10 and fast["breaching"] == 2
+    # bad_frac 0.2 / (1 - 0.9) = burn 2.0 — eating budget 2x too fast.
+    assert fast["burn_rate"] == 2.0 and fast["budget_remaining"] == 0.0
+    assert slow["total"] == 20 and slow["breaching"] == 2
+    assert slow["burn_rate"] == 1.0
+    assert lanes["samples_total"] == 20 and lanes["breaches_total"] == 2
+    assert eng.fast_burn("ttft", "interactive",
+                         now=t0) == pytest.approx(2.0)
+    # Disabled slo / empty lane → None, not 0 (no data is not health).
+    assert eng.fast_burn("queue_wait", "interactive", now=t0) is None
+    assert eng.fast_burn("ttft", "batch", now=t0) is None
+    with pytest.raises(ValueError):
+        SloEngine({"ttft": 1.0}, objective=1.5)
+
+
+def test_slo_merge_recomputes_from_counts():
+    a = SloEngine({"ttft": 100.0}, objective=0.9, windows=(10,))
+    b = SloEngine({"ttft": 100.0}, objective=0.9, windows=(10,))
+    t0 = 50.0
+    a.note("ttft", "interactive", 500.0, now=t0)     # 1/1 breaching
+    for _ in range(9):
+        b.note("ttft", "interactive", 10.0, now=t0)  # 0/9
+    merged = merge_slo([a.snapshot(now=t0), b.snapshot(now=t0)])
+    win = merged["slos"]["ttft"]["lanes"]["interactive"]["windows"]["10s"]
+    assert win["total"] == 10 and win["breaching"] == 1
+    # 0.1 bad_frac / 0.1 budget = 1.0 — NOT the mean of 10.0 and 0.0.
+    assert win["burn_rate"] == 1.0
+
+
+def test_brownout_consumes_burn_hint():
+    b = BrownoutController(100.0, eval_interval_secs=0.0)
+    # No p95 breach (no waits recorded at all) but the fast-window burn
+    # says the budget is being eaten: background trims.
+    assert b.maybe_eval(time.monotonic(), burn_fn=lambda: 2.0)
+    assert b.shares[LANE_BACKGROUND] == 0.5 and b.level == 1
+    # burn_fn returning None keeps the classic p95-only behaviour
+    # (recovery path: no samples → additive restore).
+    assert b.maybe_eval(time.monotonic(), burn_fn=lambda: None)
+    assert b.shares[LANE_BACKGROUND] > 0.5
+
+
+# ---------------------------------------------------------------------------
+# Trace span links + flight recorder retention
+# ---------------------------------------------------------------------------
+
+
+def test_trace_links_serialized_and_recorder_counts():
+    from ai_agent_kubectl_tpu.obs.recorder import FlightRecorder
+
+    tr = Trace("req-links", "POST", "/kubectl-command")
+    tr.link("preempted", from_slot=1, tokens=7)
+    tr.link("migrated", from_replica=0, cause="EngineUnavailable")
+    d = tr.to_dict()
+    assert [link["type"] for link in d["links"]] == ["preempted",
+                                                     "migrated"]
+    assert d["links"][0]["meta"]["tokens"] == 7
+    assert all("offset_ms" in link for link in d["links"])
+    rec = FlightRecorder(4)
+    rec.record(tr)
+    assert rec.get("req-links")["links"][1]["meta"]["from_replica"] == 0
+    idx = rec.list()[0]
+    assert idx["n_links"] == 2 and "links" not in idx
+
+
+# ---------------------------------------------------------------------------
+# FakeChunkedEngine: conservation under the chaos drills
+# ---------------------------------------------------------------------------
+
+
+async def _run_all(eng, prompts, **kw):
+    """Run prompts concurrently; returns (results, errors) keyed by
+    prompt order."""
+    async def one(p):
+        try:
+            return await eng.generate(p, **kw)
+        except Exception as e:
+            return e
+    return await asyncio.gather(*[one(p) for p in prompts])
+
+
+def _assert_books(eng, *, delivered_expected=None):
+    snap = eng.ledger_snapshot()
+    c = snap["conservation"]
+    assert c["balanced"], f"ledger books don't balance: {c}"
+    if delivered_expected is not None:
+        assert snap["classes"]["delivered"] == delivered_expected
+    return snap
+
+
+async def test_fake_clean_run_delivers_everything():
+    eng = FakeChunkedEngine(batch_size=2, chunk_len=4)
+    await eng.start()
+    try:
+        results = await _run_all(
+            eng, [f"clean run {i}" for i in range(6)], max_tokens=20)
+        tokens = sum(r.completion_tokens for r in results)
+        snap = _assert_books(eng, delivered_expected=tokens)
+        assert snap["goodput_pct"] == 100.0
+        assert snap["total_steps"] == tokens
+    finally:
+        await eng.stop()
+
+
+async def test_fake_nan_drill_burn_and_conservation():
+    """decode:nan chaos: the poisoned request is quarantined (its
+    generated tokens billed quarantine_burn), innocents replay
+    (replayed), and delivered matches exactly the tokens successful
+    clients received."""
+    inj = FaultInjector.from_spec("decode:nan")
+    inj.target_substr = "poison"
+    eng = FakeChunkedEngine(batch_size=3, chunk_len=4,
+                            quarantine_retry_budget=0, faults=inj)
+    await eng.start()
+    try:
+        results = await _run_all(
+            eng, ["poison pill", "innocent a", "innocent b"],
+            max_tokens=16)
+        quarantined = [r for r in results
+                       if isinstance(r, RequestQuarantined)]
+        ok = [r for r in results if not isinstance(r, Exception)]
+        assert len(quarantined) == 1 and len(ok) == 2
+        snap = _assert_books(
+            eng, delivered_expected=sum(r.completion_tokens for r in ok))
+        assert snap["classes"]["quarantine_burn"] >= 1
+        assert snap["classes"]["replayed"] >= 1
+        assert 0 < snap["goodput_pct"] < 100.0
+    finally:
+        await eng.stop()
+
+
+async def test_fake_scheduler_die_drill_conservation():
+    """scheduler:die chaos: the supervisor restarts the loop, survivors
+    replay (billed replayed), zero requests drop, and the books still
+    balance with delivered == client-received tokens."""
+    inj = FaultInjector.from_spec("scheduler:die")
+    eng = FakeChunkedEngine(batch_size=2, chunk_len=4, faults=inj)
+    await eng.start()
+    try:
+        results = await _run_all(
+            eng, [f"die drill {i}" for i in range(4)], max_tokens=20)
+        assert not any(isinstance(r, Exception) for r in results)
+        assert inj.fired("scheduler") == 1
+        _assert_books(eng, delivered_expected=sum(
+            r.completion_tokens for r in results))
+    finally:
+        await eng.stop()
+
+
+async def test_fake_flood_drill_preemption_books():
+    """tenant:flood chaos + preemption: the synthetic burst's tokens are
+    goodput too (they complete), a preempted victim's carried tokens
+    bill the preempted class at resume, and the whole run balances."""
+    inj = FaultInjector.from_spec("tenant:flood:4")
+    eng = FakeChunkedEngine(batch_size=2, chunk_len=4,
+                            preempt_wait_ms=5.0, preempt_budget=2,
+                            stream_fn=lambda p: [11] * 30 + [2],
+                            faults=inj)
+    await eng.start()
+    try:
+        with use_qos(QoSContext(tenant="probe", lane=LANE_INTERACTIVE)):
+            r = await eng.generate("interactive probe", max_tokens=4)
+        assert r.finish_reason in ("stop", "length")
+        # Let the flood drain fully so every step's fate is settled.
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if (not eng._queue and all(s is None for s in eng._slots)
+                    and not eng._inflight):
+                break
+            await asyncio.sleep(0.01)
+        snap = _assert_books(eng)
+        assert snap["classes"]["delivered"] > 0
+        q = eng.stats()["qos"]
+        if q["preemptions"]:
+            assert snap["classes"]["preempted"] > 0
+        # The flood tenant appears (hashed) in the debug table only.
+        tenants = snap["tenants"]
+        assert hash_tenant("tenant:flood") in tenants
+        assert "tenant:flood" not in tenants
+    finally:
+        await eng.stop()
+
+
+async def test_fake_preempt_resume_bills_preempted_not_replayed():
+    """Deterministic manual ticking (test_qos style): one preemption →
+    the carried tokens appear once, in the preempted class, and the
+    victim's full transcript is delivered."""
+    from tests.test_qos import _drain_text, _fake_req
+
+    eng = FakeChunkedEngine(batch_size=1, chunk_len=4,
+                            preempt_wait_ms=1.0, preempt_budget=2)
+    stream = [10 + i for i in range(20)] + [2]
+    bg = _fake_req(eng, "bulk job", lane=LANE_BACKGROUND, tenant="bulk",
+                   stream=stream, max_tokens=40)
+    eng._queue.put(bg)
+    eng._admit_pending()
+    for _ in range(3):
+        eng._tick()
+    carried = len(eng._slots[0].emitted)
+    inter = _fake_req(eng, "quick", lane=LANE_INTERACTIVE, tenant="q",
+                      stream=[7, 2], max_tokens=4)
+    eng._queue.put(inter)
+    time.sleep(0.005)
+    assert eng._maybe_preempt() is True
+    for _ in range(400):
+        eng._tick()
+        if all(s is None for s in eng._slots) and not eng._queue:
+            break
+    _, done_bg = _drain_text(bg)
+    _, done_int = _drain_text(inter)
+    snap = _assert_books(eng, delivered_expected=(
+        done_bg.completion_tokens + done_int.completion_tokens))
+    assert snap["classes"]["preempted"] == carried
+    assert snap["classes"]["replayed"] == 0
+    # Per-lane attribution: the victim's waste bills its own lane.
+    assert snap["lanes"]["background"]["preempted"] == carried
+
+
+async def test_cancelled_discard_branch_bills_hedge_loser_not_delivered():
+    """The hedge-loser contract: when the fleet flags a branch's export
+    ``discard`` before cancelling it, the engine classifies the tokens
+    that branch emitted as hedge_loser burn — NOT delivered goodput
+    (the relay only forwarded the winner's bytes) — and bills exactly
+    once, engine-side, with the request's own lane/tenant."""
+    from ai_agent_kubectl_tpu.engine.protocol import RequestExport
+
+    eng = FakeChunkedEngine(batch_size=1, chunk_len=4)
+    await eng.start()
+    try:
+        export = RequestExport()
+        agen = eng.stream_events("hedge branch", max_tokens=30,
+                                 export=export)
+        event, _ = await agen.__anext__()          # first token arrives
+        assert event == "token"
+        export.discard = True                      # fleet: you lost
+        await agen.aclose()                        # close_branch cancel
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if not any(eng._slots) and not eng._inflight:
+                break
+            await asyncio.sleep(0.01)
+        snap = _assert_books(eng)
+        assert snap["classes"]["hedge_loser"] >= 1
+        assert snap["classes"]["delivered"] == 0
+    finally:
+        await eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /debug/ledger, cardinality, /health slo, slo_* gauges
+# ---------------------------------------------------------------------------
+
+
+async def _make_client(cfg, engine):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ai_agent_kubectl_tpu.server.app import create_app
+    from ai_agent_kubectl_tpu.server.executor import CommandExecutor
+
+    app = create_app(cfg, engine,
+                     executor=CommandExecutor(timeout=cfg.execution_timeout))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _cfg(**over):
+    from ai_agent_kubectl_tpu.config import ServiceConfig
+
+    defaults = dict(engine="fake", model_name="fake", llm_timeout=5.0,
+                    rate_limit="10000/minute")
+    defaults.update(over)
+    return ServiceConfig(**defaults)
+
+
+async def test_metrics_cardinality_bounded_with_many_tenants():
+    """50 distinct tenants decode; /metrics grows by lane/class series
+    only (tenants are NEVER labels), and /debug/ledger shows them as
+    sha256 hashes."""
+    eng = FakeChunkedEngine(batch_size=4, chunk_len=4)
+    client = await _make_client(_cfg(), eng)
+    try:
+        for i in range(50):
+            with use_qos(QoSContext(tenant=f"tenant-key-{i}",
+                                    lane=LANE_INTERACTIVE)):
+                await eng.generate(f"query {i}", max_tokens=6)
+        text = await (await client.get("/metrics")).text()
+        assert "tenant-key" not in text
+        goodput_series = [ln for ln in text.splitlines()
+                          if ln.startswith("goodput_steps_total{")]
+        # lanes × classes bounds the series count: 3 × 6 == 18.
+        assert 0 < len(goodput_series) <= 18
+        assert 'goodput_steps_total{class="delivered",lane="interactive"}' \
+            in text or 'goodput_steps_total{lane="interactive",' \
+            'class="delivered"}' in text
+        assert "goodput_ratio" in text
+        body = await (await client.get("/debug/ledger")).json()
+        assert body["conservation"]["balanced"]
+        assert "tenant-key-0" not in json.dumps(body)
+        assert hash_tenant("tenant-key-0") in body["tenants"]
+        assert len(body["tenants"]) == 50
+    finally:
+        await client.close()
+
+
+async def test_health_slo_section_and_gauges():
+    eng = FakeChunkedEngine(batch_size=2, chunk_len=4,
+                            slo_ttft_ms=10_000.0,
+                            slo_interactive_ms=10_000.0)
+    client = await _make_client(_cfg(), eng)
+    try:
+        await eng.generate("warm the slo windows", max_tokens=6)
+        health = await (await client.get("/health")).json()
+        slo = health["slo"]
+        assert slo["enabled"] and slo["windows"] == ["5m", "1h"]
+        ttft = slo["slos"]["ttft"]["lanes"]["interactive"]
+        assert ttft["windows"]["5m"]["total"] >= 1
+        assert ttft["windows"]["5m"]["burn_rate"] == 0.0
+        text = await (await client.get("/metrics")).text()
+        assert 'slo_burn_rate{lane="interactive",slo="ttft",window="5m"}' \
+            in text
+        assert "slo_error_budget_remaining" in text
+        assert "slo_breaches_total" in text
+    finally:
+        await client.close()
+
+
+async def test_debug_ledger_404_without_ledger_engine():
+    client = await _make_client(_cfg(), FakeEngine())
+    try:
+        resp = await client.get("/debug/ledger")
+        assert resp.status == 404
+    finally:
+        await client.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet: stitched preempt→migrate timeline + the CI conservation smoke
+# ---------------------------------------------------------------------------
+
+
+def _throttle_dispatch(rep, min_interval: float) -> None:
+    """Rate-limit a fake replica's chunk dispatches so a 60-token decode
+    spans real wall time — the fake otherwise finishes in microseconds,
+    leaving nothing to preempt or eject mid-decode."""
+    real = rep._dispatch_chunk
+    last = [0.0]
+
+    def throttled():
+        now = time.monotonic()
+        if now - last[0] < min_interval:
+            return
+        last[0] = now
+        real()
+
+    rep._dispatch_chunk = throttled
+
+
+async def test_fleet_stitched_timeline_preempt_then_migrate():
+    """THE acceptance scenario: a background request is preempted out of
+    its slot, resumes, then its replica is ejected mid-decode and it
+    migrates — ONE trace holds the whole causal chain as span links,
+    spanning both replicas."""
+    from ai_agent_kubectl_tpu.engine.fleet import EngineFleet
+
+    reps = [FakeChunkedEngine(batch_size=1, chunk_len=2,
+                              preempt_wait_ms=5.0, preempt_budget=2,
+                              stream_fn=lambda p: [9] * 400 + [2])
+            for _ in range(2)]
+    for rep in reps:
+        _throttle_dispatch(rep, 0.02)
+    fleet = EngineFleet(reps, affinity=False)
+    await fleet.start()
+    trace = Trace("stitched-1", "POST", "/kubectl-command")
+    try:
+        async def bg_run():
+            with use_trace(trace), use_qos(
+                    QoSContext(tenant="bulk", lane=LANE_BACKGROUND)):
+                return await fleet.generate("bulk job", max_tokens=60)
+
+        bg_task = asyncio.create_task(bg_run())
+        # Wait until BOTH replicas hold background work (the second bg
+        # pins the sibling so the interactive arrival must preempt).
+        with use_qos(QoSContext(tenant="bulk2", lane=LANE_BACKGROUND)):
+            bg2_task = asyncio.create_task(
+                fleet.generate("bulk sibling", max_tokens=60))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(any(rep._slots) for rep in reps):
+                break
+            await asyncio.sleep(0.005)
+        await asyncio.sleep(0.02)    # exceed preempt_wait_ms
+        with use_qos(QoSContext(tenant="quick", lane=LANE_INTERACTIVE)):
+            await fleet.generate("interactive probe", max_tokens=2)
+        # The probe preempted ONE of the bulk requests; find the replica
+        # our traced request sits on and eject it mid-decode.
+        deadline = time.monotonic() + 5.0
+        victim_rep = None
+        while time.monotonic() < deadline and victim_rep is None:
+            for i, rep in enumerate(reps):
+                slot = rep._slots[0]
+                if slot is not None and slot.req.prompt == "bulk job":
+                    victim_rep = i
+            if victim_rep is None:
+                await asyncio.sleep(0.005)
+        assert victim_rep is not None
+        fleet.eject(victim_rep, cause="drill")
+        r = await bg_task
+        await bg2_task
+        assert r.completion_tokens == 60
+        types = [link["type"] for link in trace.to_dict()["links"]]
+        # One stitched causal chain: preempted → resumed (same replica)
+        # → migrated (replica handoff) → resumed (on the sibling).
+        assert "migrated" in types
+        if "preempted" in types:           # the probe may land either side
+            assert types.index("preempted") < types.index("migrated")
+        assert types.count("resumed") >= 1
+        mig = [link for link in trace.to_dict()["links"]
+               if link["type"] == "migrated"][0]
+        assert mig["meta"]["from_replica"] == victim_rep
+        # Fleet books: donor delivered + recipient new tokens == client
+        # bytes; the carried prefix bills replayed once.
+        snap = fleet.ledger_snapshot()
+        assert snap["conservation"]["balanced"]
+        assert snap["classes"]["replayed"] > 0
+    finally:
+        await fleet.stop()
+
+
+async def test_fleet_goodput_conservation_chaos_smoke():
+    """The CI goodput-conservation smoke (ISSUE 8 satellite): FLEET_SIZE=2
+    fake replicas behind the full HTTP app, a tenant:flood drill plus a
+    mid-run replica-0 scheduler kill and a targeted decode:nan, then
+    /debug/ledger must show balanced books and goodput > 0."""
+    from ai_agent_kubectl_tpu.engine.fleet import EngineFleet
+
+    class _KubectlFake(FakeChunkedEngine):
+        """Pieces render as a safety-passing kubectl command so the
+        full /kubectl-command path returns 200s (the stock 't<id>'
+        stream fails output parsing with a 422)."""
+
+        @staticmethod
+        def _piece(ids, offset):
+            words = " ".join(f"w{t}" for t in ids)
+            return ("kubectl get pods " + words) if offset == 0 \
+                else " " + words
+
+        def _result(self, req, ids, finish):
+            r = FakeChunkedEngine._result(self, req, ids, finish)
+            r.text = "kubectl get pods " + " ".join(f"w{t}" for t in ids)
+            return r
+
+    # The nan drill is armed from the start and FOLLOWS the poison
+    # request (target_substr); the replica-0 scheduler kill lands
+    # mid-run, with dispatches throttled so work is actually in flight.
+    inj = FaultInjector.from_spec("tenant:flood:4,decode:nan")
+    inj.target_substr = "poison"
+    reps = [_KubectlFake(batch_size=2, chunk_len=4,
+                         preempt_wait_ms=5.0,
+                         quarantine_retry_budget=0,
+                         stream_fn=lambda p: [9] * 24 + [2],
+                         faults=inj.for_replica(i))
+            for i in range(2)]
+    for rep in reps:
+        _throttle_dispatch(rep, 0.005)
+    fleet = EngineFleet(reps, affinity=False)
+    client = await _make_client(_cfg(), fleet)
+    try:
+        async def post(query):
+            resp = await client.post("/kubectl-command",
+                                     json={"query": query})
+            return resp.status, await resp.json()
+
+        tasks = [asyncio.create_task(post(f"list pods in ns drill-{i}"))
+                 for i in range(6)]
+        tasks.append(asyncio.create_task(post("list the poison pods")))
+        await asyncio.sleep(0.05)     # let requests board slots
+        inj.set("scheduler", "die", replica=0)
+        statuses = [s for s, _ in await asyncio.gather(*tasks)]
+        assert statuses.count(200) >= 6
+        assert 410 in statuses        # the poison target's quarantine
+        assert inj.fired("tenant") == 1
+        # Let the flood burst drain so every step's fate is settled.
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if all(not rep._queue and not any(rep._slots)
+                   and not rep._inflight for rep in reps):
+                break
+            await asyncio.sleep(0.01)
+        resp = await client.get("/debug/ledger")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["conservation"]["balanced"], body["conservation"]
+        assert body["classes"]["delivered"] > 0
+        assert body["goodput_pct"] and body["goodput_pct"] > 0
+        assert body["classes"]["quarantine_burn"] >= 1
+        # The drill tenants appear hashed, never raw.
+        assert "tenant:flood" not in json.dumps(body["tenants"])
+    finally:
+        await client.close()
+
+
+# ---------------------------------------------------------------------------
+# JSON logs join the ledger on (hashed tenant, lane)
+# ---------------------------------------------------------------------------
+
+
+def test_json_log_stamps_hashed_tenant_and_lane():
+    from ai_agent_kubectl_tpu.logging_setup import (JsonFormatter,
+                                                    RequestIdFilter)
+
+    logger = logging.getLogger("test.ledger.json")
+    record = logger.makeRecord("test.ledger.json", logging.INFO, __file__,
+                               1, "served one", (), None)
+    with use_qos(QoSContext(tenant="secret-api-key", lane="batch")):
+        assert RequestIdFilter().filter(record)
+    line = json.loads(JsonFormatter().format(record))
+    assert line["lane"] == "batch"
+    assert line["tenant"] == hash_tenant("secret-api-key")
+    assert "secret-api-key" not in json.dumps(line)
+    # Outside any QoS context both stamps are null, not missing.
+    record2 = logger.makeRecord("test.ledger.json", logging.INFO, __file__,
+                                1, "no context", (), None)
+    RequestIdFilter().filter(record2)
+    line2 = json.loads(JsonFormatter().format(record2))
+    assert line2["tenant"] is None and line2["lane"] is None

@@ -133,6 +133,8 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
     print_containment_summary(gauges)
     print_fleet_summary(gauges)
     print_qos_summary(gauges)
+    print_goodput_summary(gauges)
+    print_slo_summary(gauges)
 
 
 def _sum_labelled(gauges: Dict[str, float], name: str) -> Dict[str, float]:
@@ -231,6 +233,70 @@ def print_qos_summary(gauges: Dict[str, float]) -> None:
         f"{gauges.get('queue_expired_total', 0.0):>8.0f}")
     log(f"  queue displaced total       "
         f"{gauges.get('queue_displaced_total', 0.0):>8.0f}")
+
+
+def _parse_labels(labelstr: str) -> Dict[str, str]:
+    """``lane="interactive",class="delivered"`` → {lane: ..., class: ...}
+    (the two-label series the goodput/slo summaries read)."""
+    out: Dict[str, str] = {}
+    for part in labelstr.split(","):
+        k, _, v = part.partition("=")
+        if k:
+            out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+#: goodput table column order — delivered first, then the waste classes.
+_LEDGER_CLASSES = ("delivered", "replayed", "preempted", "hedge_loser",
+                   "wasted_masked", "quarantine_burn")
+
+
+def print_goodput_summary(gauges: Dict[str, float]) -> None:
+    """Goodput ledger (ISSUE 8) from the same /metrics scrape: per-lane
+    delivered vs waste breakdown and the goodput percentage — of every
+    device step the engine burned, how many became client bytes."""
+    steps = _sum_labelled(gauges, "goodput_steps_total")
+    if not steps:
+        return      # engine without the telemetry plane
+    lanes: Dict[str, Dict[str, float]] = {}
+    for labels, v in steps.items():
+        d = _parse_labels(labels)
+        lane = d.get("lane", "?")
+        lanes.setdefault(lane, {})[d.get("class", "?")] = v
+    log("probe[goodput]: goodput ledger (device steps by class)")
+    header = "  " + f"{'lane':<12}" + "".join(
+        f"{cls:>16}" for cls in _LEDGER_CLASSES) + f"{'goodput%':>10}"
+    log(header)
+    for lane in sorted(lanes):
+        row = lanes[lane]
+        total = sum(row.get(cls, 0.0) for cls in _LEDGER_CLASSES)
+        pct = 100.0 * row.get("delivered", 0.0) / total if total else 0.0
+        log("  " + f"{lane:<12}" + "".join(
+            f"{row.get(cls, 0.0):>16.0f}" for cls in _LEDGER_CLASSES)
+            + f"{pct:>9.1f}%")
+
+
+def print_slo_summary(gauges: Dict[str, float]) -> None:
+    """SLO burn rates (ISSUE 8): per-(slo, lane, window) error-budget
+    burn and remaining budget — burn 1.0 spends the budget exactly at
+    the objective's sustainable rate, above it the pager gets closer."""
+    burn = _sum_labelled(gauges, "slo_burn_rate")
+    if not burn:
+        return      # engine without the telemetry plane
+    remaining = _sum_labelled(gauges, "slo_error_budget_remaining")
+    breaches = _sum_labelled(gauges, "slo_breaches_total")
+    log("probe[slo]: error-budget burn rates")
+    log(f"  {'slo':<12} {'lane':<12} {'window':>7} {'burn':>8} "
+        f"{'budget left':>12}")
+    for labels in sorted(burn):
+        d = _parse_labels(labels)
+        log(f"  {d.get('slo', '?'):<12} {d.get('lane', '?'):<12} "
+            f"{d.get('window', '?'):>7} {burn[labels]:>8.2f} "
+            f"{remaining.get(labels, 1.0):>11.0%}")
+    for labels in sorted(breaches):
+        d = _parse_labels(labels)
+        log(f"  breaches {d.get('slo', '?')}/{d.get('lane', '?')}: "
+            f"{breaches[labels]:.0f}")
 
 
 async def http_probe(args) -> None:
