@@ -194,6 +194,33 @@ class ServiceConfig:
     # chip serves the real EP program); "dense" forces all-experts.
     moe_impl: str = "auto"                  # MOE_IMPL: auto | ep | dense
     kv_page_size: int = 16                  # KV_PAGE_SIZE (paged attention)
+    # --- block-paged KV pool + radix prefix sharing (ISSUE 10) ---
+    # Replace per-slot dense KV (every request owning an S_alloc-row
+    # region — the thing that capped the batch at bs=64 on 7B int8) with
+    # one shared [n_blocks, page, KV, hd] pool per layer + per-slot
+    # block tables: a slot holds only the pages its live span needs, so
+    # the same HBM admits ~S_alloc/avg_len x the slots (bs≈192+ on the
+    # 8B geometry). false = the dense KV ladder (A/B; also the automatic
+    # fallback under a serving mesh — pool TP sharding is ROADMAP 4).
+    kv_pool: bool = True                    # KV_POOL
+    # Pool page (tokens per block). Must divide the 128-token kv-limit
+    # tile so every gather width is a whole page count; DECODE_ATTN=auto
+    # raises it to 64 on TPU (smaller pages are grid-overhead-bound).
+    kv_pool_page: int = 16                  # KV_POOL_PAGE
+    # Total pool blocks. 0 = auto: batch_size x pages-per-slot — the
+    # dense HBM envelope, which sharing then oversubscribes. Sizing it
+    # below auto oversubscribes explicitly: admission keeps working
+    # until genuinely out (radix eviction reclaims cached blocks first),
+    # then slots truncate at their current length instead of corrupting.
+    kv_pool_blocks: int = 0                 # KV_POOL_BLOCKS
+    # Radix-tree prefix sharing over the pool (engine/radix_cache.py):
+    # concurrent users share the system prompt's blocks copy-on-write,
+    # multi-turn /execute loops re-map their whole history instead of
+    # re-prefilling it. false = pool without sharing (A/B).
+    radix_cache: bool = True                # RADIX_CACHE
+    # LRU budget (blocks) the radix tree may keep cached. 0 = auto
+    # (a quarter of the pool).
+    radix_lru_blocks: int = 0               # RADIX_LRU_BLOCKS
     hbm_prefix_cache: bool = True           # HBM_PREFIX_CACHE (system-prompt prefix KV)
     # Scheduler watchdog: if the batch scheduler makes no progress for this
     # long while work is in flight (hung device dispatch), the engine is
@@ -383,6 +410,23 @@ class ServiceConfig:
         if self.slo_ttft_ms < 0:
             raise ValueError(
                 f"SLO_TTFT_MS must be >= 0, got {self.slo_ttft_ms}")
+        # KV pool knobs (ISSUE 10): the page must divide the 128-token
+        # kv-limit tile (kv buckets are 128-tiled, so every attention
+        # gather width must be a whole page count) and the prefill-chunk
+        # alignment rides the same tile. A bad page must refuse to boot,
+        # not mis-index the pool.
+        if self.kv_pool_page < 1 or 128 % self.kv_pool_page:
+            raise ValueError(
+                f"KV_POOL_PAGE must divide the 128-token chunk/kv-limit "
+                f"tile (8|16|32|64|128), got {self.kv_pool_page}")
+        if self.kv_pool_blocks < 0:
+            raise ValueError(
+                f"KV_POOL_BLOCKS must be >= 0 (0 = auto), "
+                f"got {self.kv_pool_blocks}")
+        if self.radix_lru_blocks < 0:
+            raise ValueError(
+                f"RADIX_LRU_BLOCKS must be >= 0 (0 = auto), "
+                f"got {self.radix_lru_blocks}")
 
     @property
     def tenant_tier_map(self) -> dict:
@@ -455,6 +499,11 @@ class ServiceConfig:
             decode_attn=(_env_str("DECODE_ATTN", "auto") or "auto").lower(),
             moe_impl=(_env_str("MOE_IMPL", "auto") or "auto").lower(),
             kv_page_size=_env_int("KV_PAGE_SIZE", 16),
+            kv_pool=_env_bool("KV_POOL", True),
+            kv_pool_page=_env_int("KV_POOL_PAGE", 16),
+            kv_pool_blocks=_env_int("KV_POOL_BLOCKS", 0),
+            radix_cache=_env_bool("RADIX_CACHE", True),
+            radix_lru_blocks=_env_int("RADIX_LRU_BLOCKS", 0),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
             engine_watchdog_secs=_env_float("ENGINE_WATCHDOG_SECS", 120.0),
             engine_startup_grace_secs=_env_float(

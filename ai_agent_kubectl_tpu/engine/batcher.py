@@ -59,6 +59,8 @@ from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
                           CAUSE_SLOT_HEALTH, PROBATION_CLEAN_CHUNKS,
                           REASON_HEALTH, REASON_ISOLATED, EngineSupervisor)
 from .jax_engine import JaxEngine
+from .kv_pool import (BlockPool, alloc_with_evict, map_prefix, pages_for)
+from .radix_cache import RadixCache
 from .protocol import (HEALTH_NONFINITE, HEALTH_TOKEN_RANGE, EngineOverloaded,
                        EngineResult, EngineUnavailable, GenerationTimeout,
                        RequestExport, RequestQuarantined, TenantOverloaded,
@@ -113,7 +115,8 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
                               top_k: int, top_p: float,
                               vocab_size: int = 0,
                               health_check: bool = True,
-                              finalize=lambda arr: arr):
+                              finalize=lambda arr: arr,
+                              pool_tables: bool = False):
     """Build THE device-termination decode-chunk body: a ``lax.scan`` of
     ``chunk_len`` steps whose carry folds EOS + per-slot token budgets
     into the live mask (finished slots stop sampling, KV writes, and
@@ -140,14 +143,22 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
     post-processes the packed buffer (the engine pins it replicated
     under a mesh)."""
 
-    def batched_chunk(params, tok, pos, cache, seeds, temps, force,
-                      active, ngen, budget, corrupt):
+    def batched_chunk_impl(params, tok, pos, cache, seeds, temps, force,
+                           active, ngen, budget, corrupt, tables=None):
         live0 = jnp.logical_and(active, force)
         health0 = jnp.zeros_like(ngen)
 
         def body(carry, _):
             tok, pos, cache, live, ngen, health = carry
-            logits, cache = forward_step(params, tok, pos, cache, live)
+            if tables is None:
+                logits, cache = forward_step(params, tok, pos, cache, live)
+            else:
+                # Block-paged pool (ISSUE 10): the per-slot block table
+                # rides the dispatch as a plain argument — admissions
+                # grow tables on the host between chunks, so it cannot
+                # be a trace-time constant.
+                logits, cache = forward_step(params, tok, pos, cache,
+                                             live, tables)
             step_logits = logits[:, 0]
             step_logits = jnp.where(corrupt[:, None],
                                     jnp.float32(jnp.nan), step_logits)
@@ -192,6 +203,21 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
         packed = finalize(pack_chunk(toks, done, ngen, jnp.sum(live),
                                      health=health, xp=jnp))
         return packed, tok, pos, cache, live, ngen
+
+    if pool_tables:
+        def batched_chunk_pool(params, tok, pos, cache, seeds, temps,
+                               force, active, ngen, budget, corrupt,
+                               tables):
+            return batched_chunk_impl(params, tok, pos, cache, seeds,
+                                      temps, force, active, ngen, budget,
+                                      corrupt, tables)
+
+        return batched_chunk_pool
+
+    def batched_chunk(params, tok, pos, cache, seeds, temps, force,
+                      active, ngen, budget, corrupt):
+        return batched_chunk_impl(params, tok, pos, cache, seeds, temps,
+                                  force, active, ngen, budget, corrupt)
 
     return batched_chunk
 
@@ -317,6 +343,14 @@ class _Slot:
     exhausted: bool = False       # KV capacity reached; drain pipeline, then finish
     prefix_hit: bool = False      # served from the system-prompt prefix-KV cache
     detok_ms: float = 0.0         # host detokenization time, accumulated
+    # Block-paged KV pool (ISSUE 10): the pool blocks this slot's table
+    # maps, in page order (None in dense mode), and the admitted
+    # (possibly left-truncated) prompt ids — the basis of the radix
+    # chain inserted at finish/preempt. Growth happens at dispatch
+    # (_pool_ensure_coverage); release is deferred until every chunk
+    # whose table snapshot could write them has retired.
+    blocks: Optional[List[int]] = None
+    pool_ids: Optional[List[int]] = None
 
 
 class BatchedJaxEngine(JaxEngine):
@@ -326,6 +360,11 @@ class BatchedJaxEngine(JaxEngine):
 
     def __init__(self, *args, batch_size: int = 8, chunk_len: int = 16,
                  kv_page_size: int = 16, decode_attn: str = "auto",
+                 kv_pool: bool = True,
+                 kv_pool_page: int = 16,
+                 kv_pool_blocks: int = 0,
+                 radix_cache: bool = True,
+                 radix_lru_blocks: int = 0,
                  watchdog_secs: float = 120.0,
                  startup_grace_secs: float = 900.0,
                  admit_scratch_mb: int = 512,
@@ -381,6 +420,29 @@ class BatchedJaxEngine(JaxEngine):
         self.device_termination = device_termination
         self.kv_page_size = max(1, kv_page_size)
         self.decode_attn = decode_attn
+        # Block-paged KV pool (the ISSUE 10 tentpole): one shared
+        # [L, n_blocks, page, KV, hd] cache per layer + per-slot block
+        # tables replaces per-slot dense S_alloc regions. ``kv_pool_page``
+        # must divide the 128-token kv-limit tile (config.py validates
+        # the env knob; direct construction re-checks here).
+        # ``kv_pool_blocks`` 0 = auto (batch_size x pages-per-slot — the
+        # dense HBM envelope, which oversubscription then shares);
+        # ``radix_cache`` False = pool without prefix sharing (A/B);
+        # ``radix_lru_blocks`` 0 = auto (a quarter of the pool).
+        self.kv_pool = bool(kv_pool)
+        self.kv_pool_page = max(1, kv_pool_page)
+        if 128 % self.kv_pool_page:
+            raise ValueError(
+                f"KV_POOL_PAGE must divide the 128-token kv-limit tile, "
+                f"got {self.kv_pool_page}")
+        self.kv_pool_blocks = max(0, kv_pool_blocks)
+        self.radix_cache = bool(radix_cache)
+        self.radix_lru_blocks = max(0, radix_lru_blocks)
+        self._use_pool = False        # resolved at start (mesh fallback)
+        self._pool: Optional[BlockPool] = None
+        self._radix: Optional[RadixCache] = None
+        self._pool_prefill_fns: dict = {}   # (bucket, kv_limit) -> jitted
+        self._pool_starved = 0        # slots truncated by pool exhaustion
         self.watchdog_secs = watchdog_secs
         # Cold-start grace (VERDICT r5 weak #4): until the scheduler has
         # consumed its first pipeline entry — and whenever an admission is
@@ -551,6 +613,11 @@ class BatchedJaxEngine(JaxEngine):
             chunk_pipe_depth=cfg.chunk_pipe_depth,
             kv_page_size=cfg.kv_page_size,
             decode_attn=cfg.decode_attn,
+            kv_pool=cfg.kv_pool,
+            kv_pool_page=cfg.kv_pool_page,
+            kv_pool_blocks=cfg.kv_pool_blocks,
+            radix_cache=cfg.radix_cache,
+            radix_lru_blocks=cfg.radix_lru_blocks,
             watchdog_secs=cfg.engine_watchdog_secs,
             startup_grace_secs=cfg.engine_startup_grace_secs,
             admit_scratch_mb=cfg.admit_scratch_mb,
@@ -580,8 +647,19 @@ class BatchedJaxEngine(JaxEngine):
         self._setup_compile_cache()
         self._setup_mesh()
         self._load()
-        self._build_prefill_fns()
-        self._init_prefix_cache()
+        # Block-paged KV pool (ISSUE 10): the default serving layout. A
+        # serving mesh falls back to the dense ladder — the pool is a
+        # SHARED structure across slots, so the slots-over-``data``
+        # sharding does not apply (full-residual TP pool sharding is
+        # ROADMAP item 4's step).
+        self._use_pool = self.kv_pool and self.mesh is None
+        if self.kv_pool and self.mesh is not None:
+            logger.warning(
+                "KV_POOL does not compose with a serving mesh yet; "
+                "falling back to the dense KV ladder")
+        if not self._use_pool:
+            self._build_prefill_fns()
+            self._init_prefix_cache()
         cfg = self.model_cfg
         N, S = self.batch_size, self.max_seq_len
         # The slot caches carry one chunk of slack past max_seq so the final
@@ -608,64 +686,138 @@ class BatchedJaxEngine(JaxEngine):
             self.decode_attn, cfg,
             kv_quant=self.kv_quant,
             pipe=(self.mesh.shape["pipe"] if self.mesh is not None else 1),
-            page_size=self.kv_page_size,
+            page_size=(self.kv_pool_page if self._use_pool
+                       else self.kv_page_size),
             backend=jax.default_backend(),
         )
-        if auto_page != self.kv_page_size:
-            logger.info(
-                "DECODE_ATTN=auto: GQA model (%d q heads per KV head) "
-                "serves paged decode; KV_PAGE_SIZE %d -> %d (smaller pages "
-                "are grid-overhead-bound)",
-                cfg.q_per_kv, self.kv_page_size, auto_page)
-            self.kv_page_size = auto_page
-        if decode_impl == "paged" and self.kv_quant:
-            # The pallas paged kernel reads bf16 KV; the dense ladder's
-            # dequant fuses into its attention matmuls.
-            logger.warning("DECODE_ATTN=paged does not read int8 KV; "
-                           "falling back to the dense KV ladder")
-            decode_impl = "dense"
-        if (decode_impl == "paged" and self.mesh is not None
-                and self.mesh.shape["pipe"] > 1):
-            # The pipelined layer path always runs dense attention (the
-            # pallas call doesn't compose with the pipe stage body); keep
-            # the KV ladder rather than the paged single-bucket setup.
-            logger.warning("paged decode attention does not compose with a "
-                           "pipe mesh axis; falling back to dense")
-            decode_impl = "dense"
-        if decode_impl == "paged" and jax.default_backend() == "tpu":
-            from ..ops.paged_attention import paged_supported
-
-            if not paged_supported(self.kv_page_size, cfg.head_dim, 1):
+        if self._use_pool:
+            # The pool page IS the paged-attention page: block-table
+            # indirection and the kernel's ragged reads share one
+            # granularity. auto's grid-overhead floor applies the same
+            # way (and 64 still divides the 128-token kv-limit tile).
+            if auto_page != self.kv_pool_page:
+                logger.info("DECODE_ATTN=auto raises KV_POOL_PAGE "
+                            "%d -> %d (smaller pages are "
+                            "grid-overhead-bound)",
+                            self.kv_pool_page, auto_page)
+                self.kv_pool_page = auto_page
+            if decode_impl == "paged" and self.kv_quant:
                 logger.warning(
-                    "paged decode unsupported for page=%d head_dim=%d on "
-                    "the compiled kernel; falling back to dense",
-                    self.kv_page_size, cfg.head_dim,
-                )
+                    "DECODE_ATTN=paged does not read int8 KV; pool "
+                    "decode uses the gather path (dense attention)")
                 decode_impl = "dense"
-        self._decode_impl = decode_impl
+            if (decode_impl == "paged" and jax.default_backend() == "tpu"):
+                from ..ops.paged_attention import paged_supported
 
-        # Decode-attention cost grows with the KV span it reads. Rather
-        # than attending over the full S_alloc cache every token (round-1:
-        # cost ∝ max_seq even for 40-token sequences), the chunk program is
-        # compiled per KV *bucket* — a pow2 ladder topped by S_alloc — and
-        # dispatch picks the smallest bucket covering every live position.
-        # All buckets are warmed at startup, so bucket growth never
-        # compiles mid-serving. Paged decode needs no ladder: its cost
-        # tracks each slot's live pages inside one program.
-        from .jax_engine import kv_bucket_ladder
+                if not paged_supported(self.kv_pool_page, cfg.head_dim, 1):
+                    logger.warning(
+                        "paged pool decode unsupported for page=%d "
+                        "head_dim=%d; using the gather path",
+                        self.kv_pool_page, cfg.head_dim)
+                    decode_impl = "dense"
+            self._decode_impl = decode_impl
+            # Pool geometry: S_alloc page-rounds so every per-slot table
+            # has a whole number of pages; kv buckets are 128-tiled, and
+            # the page divides 128 by the constructor check, so every
+            # gather width is a whole page count.
+            S_alloc = -(-S_alloc // self.kv_pool_page) * self.kv_pool_page
+            from .jax_engine import kv_bucket_ladder
 
-        if decode_impl == "paged":
-            S_alloc = -(-S_alloc // self.kv_page_size) * self.kv_page_size
-            self._kv_buckets = (S_alloc,)
-        else:
-            self._kv_buckets = kv_bucket_ladder(S_alloc)
+            self._pool_max_pages = S_alloc // self.kv_pool_page
+            self._pool_n_blocks = (self.kv_pool_blocks
+                                   or N * self._pool_max_pages)
+            if self._pool_n_blocks < self._pool_max_pages:
+                raise ValueError(
+                    f"KV_POOL_BLOCKS={self._pool_n_blocks} cannot hold "
+                    f"even one full-length sequence "
+                    f"({self._pool_max_pages} pages)")
+            if decode_impl == "paged":
+                # The pallas pool kernel needs no ladder (cost tracks
+                # live pages per slot inside one program) — but PREFILL
+                # still gathers [1, kv_limit] views, so it keeps its own
+                # ladder regardless: a 40-token prompt must not gather
+                # (and attend over) the full S_alloc span.
+                self._kv_buckets = (S_alloc,)
+            else:
+                self._kv_buckets = kv_bucket_ladder(S_alloc)
+            self._pool_prefill_kv_buckets = kv_bucket_ladder(S_alloc)
+        elif not self._use_pool:
+            if auto_page != self.kv_page_size:
+                logger.info(
+                    "DECODE_ATTN=auto: GQA model (%d q heads per KV head) "
+                    "serves paged decode; KV_PAGE_SIZE %d -> %d (smaller "
+                    "pages are grid-overhead-bound)",
+                    cfg.q_per_kv, self.kv_page_size, auto_page)
+                self.kv_page_size = auto_page
+        if not self._use_pool:
+            if decode_impl == "paged" and self.kv_quant:
+                # The pallas paged kernel reads bf16 KV; the dense
+                # ladder's dequant fuses into its attention matmuls.
+                logger.warning("DECODE_ATTN=paged does not read int8 KV; "
+                               "falling back to the dense KV ladder")
+                decode_impl = "dense"
+            if (decode_impl == "paged" and self.mesh is not None
+                    and self.mesh.shape["pipe"] > 1):
+                # The pipelined layer path always runs dense attention
+                # (the pallas call doesn't compose with the pipe stage
+                # body); keep the KV ladder rather than the paged
+                # single-bucket setup.
+                logger.warning("paged decode attention does not compose "
+                               "with a pipe mesh axis; falling back to "
+                               "dense")
+                decode_impl = "dense"
+            if decode_impl == "paged" and jax.default_backend() == "tpu":
+                from ..ops.paged_attention import paged_supported
+
+                if not paged_supported(self.kv_page_size, cfg.head_dim, 1):
+                    logger.warning(
+                        "paged decode unsupported for page=%d head_dim=%d "
+                        "on the compiled kernel; falling back to dense",
+                        self.kv_page_size, cfg.head_dim,
+                    )
+                    decode_impl = "dense"
+            self._decode_impl = decode_impl
+
+            # Decode-attention cost grows with the KV span it reads.
+            # Rather than attending over the full S_alloc cache every
+            # token (round-1: cost ∝ max_seq even for 40-token
+            # sequences), the chunk program is compiled per KV *bucket*
+            # — a pow2 ladder topped by S_alloc — and dispatch picks the
+            # smallest bucket covering every live position. All buckets
+            # are warmed at startup, so bucket growth never compiles
+            # mid-serving. Paged decode needs no ladder: its cost tracks
+            # each slot's live pages inside one program.
+            from .jax_engine import kv_bucket_ladder
+
+            if decode_impl == "paged":
+                S_alloc = -(-S_alloc // self.kv_page_size) \
+                    * self.kv_page_size
+                self._kv_buckets = (S_alloc,)
+            else:
+                self._kv_buckets = kv_bucket_ladder(S_alloc)
 
         eos_ids = tuple(sorted(set(cfg.eos_ids)))
 
         def chunk_forward_step(kv_limit):
             """The model call the shared chunk body runs per step:
             forward over cache[:, :kv_limit] with the live mask gating
-            MoE capacity (token_mask) and the KV scatter (write_mask)."""
+            MoE capacity (token_mask) and the KV scatter (write_mask).
+            Pool mode threads the per-slot block table through — every
+            KV write and read then routes the [n_blocks, page] pool."""
+
+            if self._use_pool:
+                def step(params, tok, pos, cache, live, tables):
+                    return forward(params, cfg, tok, pos, cache,
+                                   kv_limit=kv_limit,
+                                   attn_impl=self._decode_impl,
+                                   mesh=None,
+                                   moe_impl=self.moe_impl,
+                                   token_mask=live[:, None],
+                                   write_mask=live,
+                                   page_size=self.kv_pool_page,
+                                   block_tables=tables)
+
+                return step
 
             def step(params, tok, pos, cache, live):
                 return forward(params, cfg, tok, pos, cache,
@@ -694,10 +846,12 @@ class BatchedJaxEngine(JaxEngine):
                 chunk_forward_step(kv_limit), self.chunk_len, eos_ids,
                 self.top_k, self.top_p, vocab_size=cfg.vocab_size,
                 health_check=self.slot_health_check,
-                finalize=self._replicated)
+                finalize=self._replicated,
+                pool_tables=self._use_pool)
 
         def batched_chunk_legacy(params, tok, pos, cache, seeds, temps,
-                                 force, active, ngen, budget, corrupt, *,
+                                 force, active, ngen, budget, corrupt,
+                                 tables=None, *,
                                  kv_limit):
             """DEVICE_TERMINATION=false: the pre-ISSUE-4 chunk body —
             every force-live slot decodes the full chunk (finished slots
@@ -715,10 +869,14 @@ class BatchedJaxEngine(JaxEngine):
                 logits, cache = forward(params, cfg, tok, pos, cache,
                                         kv_limit=kv_limit,
                                         attn_impl=self._decode_impl,
-                                        mesh=self.mesh,
+                                        mesh=(None if tables is not None
+                                              else self.mesh),
                                         moe_impl=self.moe_impl,
                                         token_mask=force[:, None],
-                                        page_size=self.kv_page_size)
+                                        page_size=(self.kv_pool_page
+                                                   if tables is not None
+                                                   else self.kv_page_size),
+                                        block_tables=tables)
                 step_logits = logits[:, 0]
                 step_logits = jnp.where(corrupt[:, None],
                                         jnp.float32(jnp.nan), step_logits)
@@ -816,6 +974,21 @@ class BatchedJaxEngine(JaxEngine):
         # first token.
         self._inflight: List[tuple] = []
 
+        if self._use_pool:
+            self._pool_warmup()
+            self._batch_warm_thread = None
+        else:
+            self._dense_warmup()
+        self._post_warm_threads(t0)
+        return
+
+    def _dense_warmup(self) -> None:
+        """Eager startup warm of the dense-ladder serving programs:
+        smallest prefill bucket, every KV-bucket decode chunk, the
+        splice, and the hot group-admission shape (by execution — the
+        only safe time to run cache-donating programs)."""
+        cfg = self.model_cfg
+        N, S = self.batch_size, self.max_seq_len
         # Warm-up: smallest prefill bucket + the decode chunk + splice.
         b = self.prefill_buckets[0]
         scratch = self._new_cache(1, S)
@@ -918,6 +1091,11 @@ class BatchedJaxEngine(JaxEngine):
         )
         self._batch_warm_thread.start()
 
+    def _post_warm_threads(self, t0: float) -> None:
+        """Start the scheduler/supervision threads once warm-up is done
+        (shared tail of the pool and dense startup paths)."""
+        cfg = self.model_cfg
+        N = self.batch_size
         self._running = True
         self._worker = threading.Thread(
             target=self._worker_main, name="batch-scheduler", daemon=True
@@ -947,7 +1125,28 @@ class BatchedJaxEngine(JaxEngine):
         the fault-containment reset path (_reset_decode_state) — one
         function so a reset can never drift from a fresh start."""
         N = self.batch_size
-        self._cache = self._new_cache(N, self._S_alloc)
+        if self._use_pool:
+            # Pool mode: the shared block cache replaces per-slot dense
+            # regions, and the HOST allocator/radix/tables are rebuilt
+            # with it — a reset invalidates every cached block's KV, so
+            # the whole ownership world restarts from empty (replays
+            # re-allocate; the radix tree repopulates organically).
+            self._cache = self._new_pool_cache()
+            prev_pool, prev_radix = self._pool, self._radix
+            self._pool = BlockPool(self._pool_n_blocks, self.kv_pool_page)
+            self._radix = (RadixCache(self._pool,
+                                      max_blocks=self.radix_lru_blocks)
+                           if self.radix_cache else None)
+            # Cumulative counters survive the rebuild — the /metrics
+            # delta-mirror must never see totals go backwards.
+            if prev_pool is not None:
+                self._pool.carry_counters(prev_pool)
+            if prev_radix is not None and self._radix is not None:
+                self._radix.carry_counters(prev_radix)
+            self._tables = np.full((N, self._pool_max_pages),
+                                   self._pool_n_blocks, np.int32)
+        else:
+            self._cache = self._new_cache(N, self._S_alloc)
         self._tok_d = jnp.zeros((N, 1), jnp.int32)
         self._pos_d = jnp.zeros((N, 1), jnp.int32)
         self._temps_d = jnp.zeros((N,), jnp.float32)
@@ -977,6 +1176,395 @@ class BatchedJaxEngine(JaxEngine):
             self._budget_d = shard_tokens(self._budget_d, self.mesh)
             self._seeds_d = shard_tokens(self._seeds_d, self.mesh)
             self._no_corrupt_d = shard_tokens(self._no_corrupt_d, self.mesh)
+
+    # ------------------------------------- block-paged KV pool (ISSUE 10)
+    #
+    # Ownership model: the HOST is truth — BlockPool refcounts + the
+    # per-slot numpy table rows; device arrays only ever see table
+    # SNAPSHOTS at dispatch. Freeing is immediate (no quiesce): every
+    # device program executes in dispatch order on one stream, so a
+    # stale in-flight chunk's writes to a freed block land BEFORE any
+    # new owner's prefill/decode writes, and a new owner (re)writes
+    # every row it will ever read — stale garbage can never surface.
+
+    def _new_pool_cache(self) -> KVCache:
+        """The shared [L, n_blocks, page, KV, hd] cache (QuantKV leaves
+        under KV_QUANT=int8). ``lengths`` is [n_blocks]-shaped and purely
+        structural — per-slot lengths are host truth (slot.pos)."""
+        cfg = self.model_cfg
+        shape = (cfg.n_layers, self._pool_n_blocks, self.kv_pool_page,
+                 cfg.n_kv_heads, cfg.head_dim)
+        lengths = jnp.zeros((self._pool_n_blocks,), jnp.int32)
+        if self.kv_quant == "int8":
+            from ..ops.quant import QuantKV
+
+            def zq():
+                return QuantKV(q=jnp.zeros(shape, jnp.int8),
+                               s=jnp.ones(shape[:-1], jnp.float32))
+
+            return KVCache(k=zq(), v=zq(), lengths=lengths)
+        return KVCache(k=jnp.zeros(shape, self.dtype),
+                       v=jnp.zeros(shape, self.dtype), lengths=lengths)
+
+    def _pool_kv_limit(self, needed: int) -> int:
+        """Smallest PREFILL KV bucket covering ``needed`` positions
+        (every bucket is a whole page count: 128-tiled ladder, page
+        divides 128). Prefill keeps its own ladder even when paged
+        decode collapses the chunk buckets to (S_alloc,) — the gather
+        width must track the prompt, not the cache."""
+        needed = min(needed, self._S_alloc)
+        return next(b for b in self._pool_prefill_kv_buckets
+                    if b >= needed)
+
+    def _get_pool_prefill_fn(self, bucket: int, kv_limit: int):
+        """Prefill program writing INTO the pool through a block table:
+        one [1, bucket] token chunk at absolute offset positions,
+        attending over the table's first kv_limit/page pages. This is
+        what makes group-admission scratch obsolete — suffixes prefill
+        directly into freshly allocated blocks, no staging cache and no
+        splice copy."""
+        key = (bucket, kv_limit)
+        fn = self._pool_prefill_fns.get(key)
+        if fn is None:
+            cfg = self.model_cfg
+            impl = self._prefill_impl_for(bucket, kv_limit)
+
+            def pool_prefill(params, tokens, positions, cache, mask,
+                             tables):
+                last = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1,
+                                   0)
+                return forward(params, cfg, tokens, positions, cache,
+                               kv_limit=kv_limit, attn_impl=impl,
+                               mesh=None, moe_impl=self.moe_impl,
+                               token_mask=mask, logits_at=last,
+                               page_size=self.kv_pool_page,
+                               block_tables=tables)
+
+            fn = jax.jit(pool_prefill, donate_argnums=(3,))
+            self._pool_prefill_fns[key] = fn
+        return fn
+
+    @property
+    def _pool_arm_fn(self):
+        """Jitted slot-arming program — the splice minus the KV copy
+        (prefill already wrote the pool through the table): carry token,
+        position, temperature, termination carry, sampling seed."""
+        fn = getattr(self, "_pool_arm_jit", None)
+        if fn is None:
+            def arm(tok, pos, temps, active, ngen, budget, seeds, slot,
+                    n_prompt, first_tok, temperature, max_toks, seed,
+                    ngen0):
+                with jax.named_scope("kv_splice"):
+                    tok = tok.at[slot, 0].set(first_tok[0])
+                    pos = pos.at[slot, 0].set(n_prompt)
+                    temps = temps.at[slot].set(temperature)
+                    active = active.at[slot].set(max_toks > ngen0)
+                    ngen = ngen.at[slot].set(ngen0)
+                    budget = budget.at[slot].set(max_toks)
+                    seeds = seeds.at[slot].set(seed)
+                return tok, pos, temps, active, ngen, budget, seeds
+
+            fn = jax.jit(arm, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+            self._pool_arm_jit = fn
+        return fn
+
+    def _run_arm(self, slot_idx: int, n_prompt: int, first_tok_d,
+                 temperature: float, max_toks: int, seed: int,
+                 ngen0: int) -> None:
+        (self._tok_d, self._pos_d, self._temps_d, self._active_d,
+         self._ngen_d, self._budget_d, self._seeds_d) = self._pool_arm_fn(
+            self._tok_d, self._pos_d, self._temps_d, self._active_d,
+            self._ngen_d, self._budget_d, self._seeds_d,
+            jnp.asarray(slot_idx, jnp.int32),
+            jnp.asarray(n_prompt, jnp.int32), first_tok_d,
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(max_toks, jnp.int32),
+            jnp.asarray(seed, jnp.int32),
+            jnp.asarray(ngen0, jnp.int32),
+        )
+
+    @property
+    def _pool_cow_fn(self):
+        """Jitted copy-on-write: copy the first ``rows`` KV rows of pool
+        block ``src`` into block ``dst`` (rows is dynamic — one compiled
+        program serves every partial-tail width; rows beyond it scatter
+        out of bounds and drop)."""
+        fn = getattr(self, "_pool_cow_jit", None)
+        if fn is None:
+            page = self.kv_pool_page
+
+            def cow(cache, src_b, dst_b, rows):
+                offs = jnp.arange(page)
+
+                def cp(leaf):
+                    Lx, nb = leaf.shape[0], leaf.shape[1]
+                    f = leaf.reshape((Lx, nb * page) + leaf.shape[3:])
+                    src_rows = f[:, src_b * page + offs]
+                    dst_idx = jnp.where(offs < rows, dst_b * page + offs,
+                                        nb * page)
+                    f = f.at[:, dst_idx].set(src_rows)
+                    return f.reshape(leaf.shape)
+
+                with jax.named_scope("kv_splice"):
+                    return KVCache(k=jax.tree.map(cp, cache.k),
+                                   v=jax.tree.map(cp, cache.v),
+                                   lengths=cache.lengths)
+
+            fn = jax.jit(cow, donate_argnums=(0,))
+            self._pool_cow_jit = fn
+        return fn
+
+    def _run_cow(self, src: int, dst: int, rows: int) -> None:
+        self._cache = self._pool_cow_fn(
+            self._cache, jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32), jnp.asarray(rows, jnp.int32))
+
+    def _pool_alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate with radix-eviction backpressure (kv_pool.py helper,
+        shared verbatim with the fake engine)."""
+        return alloc_with_evict(self._pool, self._radix, n)
+
+    def _pool_map_prefix(self, ids: List[int],
+                         match_all: bool = False) -> tuple:
+        """Build a slot's block chain (kv_pool.map_prefix — THE shared
+        admission path, run verbatim by the fake engine too): shared
+        full blocks + tail COW (the device copy is this engine's jitted
+        ``_run_cow``) + fresh blocks. Returns (blocks, m)."""
+        return map_prefix(self._pool, self._radix, ids,
+                          match_all=match_all, cow=self._run_cow)
+
+    def _pool_prefill_span(self, table_row: np.ndarray, ids: List[int],
+                           start: int):
+        """Prefill ``ids[start:]`` at absolute offsets through the
+        slot's table, largest-bucket chunks (the unified short / suffix /
+        long-prompt path — a chunk IS a suffix of everything before it).
+        Returns the last valid position's logits [1, V]."""
+        n = len(ids)
+        big = self.prefill_buckets[-1]
+        tables_d = jnp.asarray(table_row[None])
+        offset, logits = start, None
+        while offset < n:
+            L = min(big, n - offset)
+            bucket = next(b for b in self.prefill_buckets if b >= L)
+            kv_limit = self._pool_kv_limit(offset + bucket)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :L] = ids[offset:offset + L]
+            positions = np.broadcast_to(
+                offset + np.arange(bucket), (1, bucket)).astype(np.int32)
+            mask = (np.arange(bucket) < L)[None, :].astype(np.float32)
+            logits, self._cache = self._get_pool_prefill_fn(
+                bucket, kv_limit)(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                self._cache, jnp.asarray(mask), tables_d)
+            offset += L
+        return logits[:, 0]
+
+    def _pool_ensure_coverage(self, idx: int, slot: "_Slot") -> bool:
+        """Grow the slot's table to cover the next chunk's writes.
+        False = pool exhausted even after radix eviction: the slot is
+        marked exhausted and finishes at its current length once its
+        in-flight chunks drain (oversubscription's honest failure mode —
+        truncation, never corruption)."""
+        target = min(slot.pos + self.chunk_len, self._S_alloc)
+        need = pages_for(target, self.kv_pool_page)
+        while len(slot.blocks) < need:
+            b = self._pool_alloc(1)
+            if b is None:
+                slot.exhausted = True
+                self._pool_starved += 1
+                if slot.req.trace is not None:
+                    slot.req.trace.event(
+                        f"engine: kv pool exhausted at position "
+                        f"{slot.pos} — finishing at current length")
+                logger.warning(
+                    "kv pool exhausted: slot truncated at position %d "
+                    "(%d blocks live, %d cached)", slot.pos,
+                    self._pool.n_blocks - self._pool.free_count,
+                    self._radix.cached_block_count()
+                    if self._radix else 0)
+                return False
+            self._tables[idx, len(slot.blocks)] = b[0]
+            slot.blocks.extend(b)
+            if slot.req.export is not None:
+                slot.req.export.blocks = list(slot.blocks)
+        return True
+
+    def _pool_release_slot(self, idx: Optional[int], slot: "_Slot",
+                           cache_chain: bool = True) -> None:
+        """Release a leaving slot's block refs. ``cache_chain`` first
+        inserts the request's verified KV chain (admitted prompt +
+        emitted[:-1] — rows the device has definitely written) into the
+        radix tree, so completion feeds sharing: the next turn of this
+        agent loop, or a preempted victim's resume, re-maps these blocks
+        instead of re-prefilling."""
+        if idx is not None:
+            self._tables[idx, :] = self._pool_n_blocks
+        if not slot.blocks:
+            slot.blocks = []
+            return
+        if cache_chain and self._radix is not None and slot.pool_ids:
+            gen = list(slot.detok.ids)
+            chain = slot.pool_ids + (gen[:-1] if gen else [])
+            chain = chain[:len(slot.blocks) * self.kv_pool_page]
+            try:
+                self._radix.insert(chain, slot.blocks)
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("radix insert failed; chain not cached")
+        self._pool.decref(slot.blocks)
+        slot.blocks = []
+
+    def _admit_one_pool(self, req: _Request) -> None:
+        """Pool-mode admission: radix-match the prompt, map shared
+        blocks copy-on-write, prefill ONLY the unmatched suffix straight
+        into freshly allocated blocks, sample the first token, arm the
+        slot vectors. Turn N+1 of an agent loop (prompt extends the
+        cached prompt+completion chain) becomes incremental prefill; N
+        users sharing the system prompt cost one block set."""
+        slot_idx = self._slots.index(None)
+        t_adm = time.monotonic()
+        wait_ms = (t_adm - req.t_submit) * 1000.0
+        self._brownout.note_queue_wait(req.lane, wait_ms, now=t_adm)
+        self._slo.note(SLO_QUEUE_WAIT, req.lane, wait_ms, now=t_adm)
+
+        ids = list(req.prompt_ids)
+        max_prompt = self.max_seq_len - max(1, req.max_tokens)
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]
+        n_prompt = len(ids)
+        blocks, m = self._pool_map_prefix(ids)
+        try:
+            self._tables[slot_idx, :] = self._pool_n_blocks
+            self._tables[slot_idx, :len(blocks)] = blocks
+            last_logits = self._pool_prefill_span(
+                self._tables[slot_idx], ids, m)
+            first_key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
+            first_tok_d = self._sample_fn(
+                last_logits, first_key,
+                jnp.asarray(req.temperature, jnp.float32))
+            self._run_arm(slot_idx, n_prompt, first_tok_d,
+                          req.temperature, req.max_tokens, req.seed, 1)
+        except Exception:
+            self._tables[slot_idx, :] = self._pool_n_blocks
+            self._pool.decref(blocks)
+            raise
+        slot = _Slot(
+            req=req,
+            detok=StreamDecoder(self.tokenizer),
+            n_prompt=n_prompt,
+            pos=n_prompt,
+            queue_ms=wait_ms,
+            t_admit=t_adm,
+            t_decode0=t_adm,
+            chunks_inflight=1,
+            prefix_hit=m > 0,
+            blocks=blocks,
+            pool_ids=ids,
+        )
+        if req.export is not None:
+            req.export.blocks = list(blocks)
+        if req.trace is not None:
+            req.trace.event(
+                f"engine: admitted to slot {slot_idx} ({n_prompt} prompt "
+                f"tokens, {m} radix-matched, "
+                f"{pages_for(n_prompt, self.kv_pool_page)} pool blocks)")
+        self._slots[slot_idx] = slot
+        self._to_host_async(first_tok_d)
+        self._inflight.append(("first", first_tok_d, req, slot_idx))
+        self._last_admit_t = time.monotonic()
+
+    def _pool_warmup(self) -> None:
+        """Eager startup warm of the pool serving programs: the smallest
+        prefill bucket (through a table), the sampler, the arm and COW
+        programs, and every KV-bucket decode chunk. Warm blocks are
+        freed after (their garbage is rewritten before any future owner
+        reads it), then the radix tree is preloaded with the system
+        prompt so the very first request prefix-shares."""
+        cfg = self.model_cfg
+        N = self.batch_size
+        b = self.prefill_buckets[0]
+        row = np.full((self._pool_max_pages,), self._pool_n_blocks,
+                      np.int32)
+        blocks = self._pool.alloc(
+            min(pages_for(b, self.kv_pool_page), self._pool_max_pages))
+        row[:len(blocks)] = blocks
+        self._pool_prefill_span(row, [0] * b, 0)
+        self._key_d = jax.random.PRNGKey(self.seed)
+        self._sample_fn(
+            jnp.zeros((1, cfg.vocab_size), jnp.float32), self._key_d,
+            jnp.asarray(0.0, jnp.float32),
+        )
+        self._run_arm(0, 1, jnp.zeros((1,), jnp.int32), 0.0, 1, 0, 1)
+        self._run_cow(blocks[0], blocks[0], 0)
+        tables_d = jnp.asarray(self._tables)
+        for kv_b in self._kv_buckets:
+            (packed, self._tok_d, self._pos_d, self._cache,
+             self._active_d, self._ngen_d) = (
+                self._batch_chunk_fns[kv_b](
+                    self.params, self._tok_d, self._pos_d, self._cache,
+                    self._seeds_d, self._temps_d,
+                    jnp.zeros((N,), jnp.bool_),
+                    self._active_d, self._ngen_d, self._budget_d,
+                    self._no_corrupt_d, tables_d)
+            )
+        packed.block_until_ready()
+        self._pool.decref(blocks)
+        self._pool_preload_system_prompt()
+
+    def _pool_preload_system_prompt(self) -> None:
+        """Prefill the shared system prompt once at startup and leave
+        its chain CACHED in the radix tree — the pool-mode analog of the
+        dense path's resident PrefixKV (engine/prefix_cache.py), behind
+        the same HBM_PREFIX_CACHE knob. Unlike the dense prefix, it
+        shares under LRU like any other chain (every request touches it,
+        so it stays hot) and does not survive an engine reset (the next
+        admission re-prefills and re-caches it)."""
+        if self._radix is None or not self.use_prefix_cache:
+            return
+        from .prompts import SYSTEM_PROMPT
+
+        ids = self.tokenizer.encode(SYSTEM_PROMPT)
+        P = len(ids)
+        if P + self.prefill_buckets[0] > self.max_seq_len:
+            logger.warning(
+                "Radix preload skipped: system prompt is %d tokens; no "
+                "room for a suffix within max_seq %d", P, self.max_seq_len)
+            return
+        need = pages_for(P, self.kv_pool_page)
+        if need > self._radix.max_blocks:
+            logger.warning(
+                "Radix preload skipped: system prompt needs %d blocks, "
+                "RADIX_LRU_BLOCKS budget is %d", need,
+                self._radix.max_blocks)
+            return
+        blocks = self._pool_alloc(need)
+        if blocks is None:  # pragma: no cover - tiny pools only
+            logger.warning("Radix preload skipped: pool too small")
+            return
+        row = np.full((self._pool_max_pages,), self._pool_n_blocks,
+                      np.int32)
+        row[:need] = blocks
+        try:
+            self._pool_prefill_span(row, list(ids), 0)
+            self._radix.insert(list(ids), blocks)
+        finally:
+            self._pool.decref(blocks)
+        logger.info(
+            "Radix cache preloaded: %d-token system prompt resident in "
+            "%d pool blocks", P, need)
+
+    def kv_pool_health(self) -> Optional[dict]:
+        """Cheap pool view for /health (never stats() — same rule as
+        qos_health): block-state counts, sharing/COW totals, radix
+        hit-rate counters."""
+        if not self._use_pool or self._pool is None:
+            return None
+        cached = (self._radix.cached_blocks() if self._radix is not None
+                  else ())
+        body = self._pool.stats(cached).as_dict()
+        body["starved_slots_total"] = self._pool_starved
+        body["radix"] = (self._radix.stats() if self._radix is not None
+                         else None)
+        return body
 
     def _warm_batch_admit_shapes(self) -> None:
         """Background-compile group-admission programs for the non-smallest
@@ -1151,14 +1739,21 @@ class BatchedJaxEngine(JaxEngine):
         pushed): slot occupancy, admission queue depth, and page-granular
         KV-pool accounting (page size = KV_PAGE_SIZE)."""
         slots = list(getattr(self, "_slots", None) or [])
-        page = self.kv_page_size
-        pages_per_slot = -(-self.max_seq_len // page)
-        # pos can run into the S_alloc slack on a final chunk; clamp so
-        # used never exceeds total (utilization ratios stay <= 1).
-        used = sum(
-            -(-min(s.pos, self.max_seq_len) // page)
-            for s in slots if s is not None
-        )
+        if self._use_pool and self._pool is not None:
+            # Pool truth: pages = pool blocks, used = everything not on
+            # the free list (live slot mappings + radix-cached chains).
+            used = self._pool.n_blocks - self._pool.free_count
+            pages_total = self._pool.n_blocks
+        else:
+            page = self.kv_page_size
+            pages_per_slot = -(-self.max_seq_len // page)
+            # pos can run into the S_alloc slack on a final chunk; clamp
+            # so used never exceeds total (utilization ratios stay <= 1).
+            used = sum(
+                -(-min(s.pos, self.max_seq_len) // page)
+                for s in slots if s is not None
+            )
+            pages_total = self.batch_size * pages_per_slot
         # Windowed decode throughput (engine_tokens_per_sec): tokens
         # completed over the trailing window, counted at the scheduler —
         # covers every finish (streams included), immune to the
@@ -1180,7 +1775,12 @@ class BatchedJaxEngine(JaxEngine):
             "batch_occupancy": sum(s is not None for s in slots),
             "queue_depth": self._admissions.qsize(),
             "kv_pages_used": used,
-            "kv_pages_total": self.batch_size * pages_per_slot,
+            "kv_pages_total": pages_total,
+            # Block-paged pool + radix sharing (ISSUE 10): block-state
+            # counts, sharing/COW totals, radix hit/miss token counters
+            # — delta-mirrored into Prometheus at scrape time
+            # (Metrics.observe_kv_pool) and summarized in /health.
+            "kv_pool": self.kv_pool_health(),
             "queue_rejections": self._rejections,
             "max_queue_depth": self.max_queue_depth,
             "tokens_per_sec_window": tok_window / self.TOKEN_RATE_WINDOW_SECS,
@@ -1509,10 +2109,17 @@ class BatchedJaxEngine(JaxEngine):
                     reasons[id(slot)] = REASON_ISOLATED
 
         # Tear down: slots detach, the speculative pipeline drops, and
-        # the device state is rebuilt exactly as startup built it.
+        # the device state is rebuilt exactly as startup built it. Pool
+        # mode: the rebuilt allocator/radix world starts empty, so every
+        # survivor's block list is a stale previous-generation view —
+        # cleared here; replays re-allocate (and must NEVER decref stale
+        # ids into the fresh pool).
         self._slots = [None] * self.batch_size
         self._inflight.clear()
         self._reset_decode_state()
+        if self._use_pool:
+            for s in survivors:
+                s.blocks = []
         self.supervisor.note_reset(cause)
 
         qset = {id(s) for s in quarantined}
@@ -1642,23 +2249,61 @@ class BatchedJaxEngine(JaxEngine):
         g = len(ids)
         slot_idx = self._slots.index(None)
         replay_ids = list(req.prompt_ids) + ids[:-1]
-        last_logits, scratch, n_total, _ = self._prefill_prompt(
-            replay_ids, max(1, req.max_tokens - g))
-        del last_logits  # the next token is sampled in-chunk, not here
-        (self._cache, self._tok_d, self._pos_d, self._temps_d,
-         self._active_d, self._ngen_d, self._budget_d,
-         self._seeds_d) = self._splice_fn(
-            self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
-            self._temps_d, self._active_d, self._ngen_d, self._budget_d,
-            self._seeds_d,
-            jnp.asarray(slot_idx, jnp.int32),
-            jnp.asarray(n_total, jnp.int32),
-            jnp.asarray([ids[-1]], jnp.int32),
-            jnp.asarray(req.temperature, jnp.float32),
-            jnp.asarray(req.max_tokens, jnp.int32),
-            jnp.asarray(req.seed, jnp.int32),
-            jnp.asarray(g, jnp.int32),
-        )
+        if self._use_pool:
+            # Pool replay: the re-derivation is a radix match first — a
+            # preempted victim's chain was cached at preemption, so its
+            # resume re-maps shared blocks (plus one tail COW) and
+            # prefills NOTHING instead of re-prefilling prompt+prefix;
+            # after a containment reset the tree is empty and this
+            # degenerates to a full prefill into fresh blocks, exactly
+            # the dense path's semantics.
+            max_prompt = self.max_seq_len - max(1, req.max_tokens - g)
+            if len(replay_ids) > max_prompt:
+                replay_ids = replay_ids[-max_prompt:]
+            n_total = len(replay_ids)
+            blocks, m = self._pool_map_prefix(replay_ids, match_all=True)
+            try:
+                self._tables[slot_idx, :] = self._pool_n_blocks
+                self._tables[slot_idx, :len(blocks)] = blocks
+                if m < n_total:
+                    self._pool_prefill_span(self._tables[slot_idx],
+                                            replay_ids, m)
+                self._run_arm(slot_idx, n_total,
+                              jnp.asarray([ids[-1]], jnp.int32),
+                              req.temperature, req.max_tokens, req.seed, g)
+            except Exception:
+                self._tables[slot_idx, :] = self._pool_n_blocks
+                self._pool.decref(blocks)
+                raise
+            slot.blocks = blocks
+            # The chain basis (admitted prompt part) for the eventual
+            # radix insert: replay_ids minus the g-1 generated ids.
+            slot.pool_ids = replay_ids[:n_total - (g - 1)] if g > 1 \
+                else replay_ids
+            if req.export is not None:
+                req.export.blocks = list(blocks)
+            if req.trace is not None and m > 0:
+                req.trace.event(
+                    f"engine: replay re-mapped {m}/{n_total} tokens from "
+                    f"shared pool blocks (prefilled {n_total - m})")
+        else:
+            last_logits, scratch, n_total, _ = self._prefill_prompt(
+                replay_ids, max(1, req.max_tokens - g))
+            del last_logits  # the next token is sampled in-chunk, not here
+            (self._cache, self._tok_d, self._pos_d, self._temps_d,
+             self._active_d, self._ngen_d, self._budget_d,
+             self._seeds_d) = self._splice_fn(
+                self._cache, scratch.k, scratch.v, self._tok_d, self._pos_d,
+                self._temps_d, self._active_d, self._ngen_d, self._budget_d,
+                self._seeds_d,
+                jnp.asarray(slot_idx, jnp.int32),
+                jnp.asarray(n_total, jnp.int32),
+                jnp.asarray([ids[-1]], jnp.int32),
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.max_tokens, jnp.int32),
+                jnp.asarray(req.seed, jnp.int32),
+                jnp.asarray(g, jnp.int32),
+            )
         slot.pos = n_total
         slot.chunks_inflight = 0
         slot.decode_chunks_inflight = 0
@@ -1729,6 +2374,9 @@ class BatchedJaxEngine(JaxEngine):
             self._slots = [None] * self.batch_size
             self._inflight.clear()
             self._reset_decode_state()
+            if self._use_pool:
+                for s in survivors:
+                    s.blocks = []
             self.supervisor.note_reset(CAUSE_SCHEDULER_DEATH)
             for slot in survivors:
                 self._guarded_replay(slot)
@@ -1767,7 +2415,13 @@ class BatchedJaxEngine(JaxEngine):
         warm-up compiles and scratch HBM. Empty at batch_size==1: the
         group path is structurally unreachable there (a burst can never
         pop more than one free slot's worth). Per-shape HBM capping on
-        top of this list lives in ``admit_kpads_for``."""
+        top of this list lives in ``admit_kpads_for``. POOL mode returns
+        empty: suffixes prefill directly into freshly allocated blocks
+        (no staging scratch), which makes the whole group-admission
+        scratch machinery — and its ADMIT_SCRATCH_MB budget — obsolete
+        there (the ISSUE 10 contract)."""
+        if self._use_pool:
+            return ()
         return tuple(k for k in self.ADMIT_KPADS if k <= self.batch_size)
 
     def admit_kpads_for(self, depth: int) -> tuple:
@@ -1944,6 +2598,13 @@ class BatchedJaxEngine(JaxEngine):
             # joins this segment to the later resume by these links.
             req.trace.link("preempted", from_slot=idx, tokens=len(ids),
                            for_lane=for_lane, lane=req.lane)
+        if self._use_pool:
+            # Cache the victim's verified chain before releasing its
+            # blocks: the resume (or any cohabitant sharing the prefix)
+            # re-maps them from the radix tree instead of re-prefilling
+            # — preemption becomes a block-table operation, not a
+            # recompute.
+            self._pool_release_slot(idx, slot, cache_chain=True)
         self._admissions.requeue_head(req)
 
     def _inject_flood(self, n: int, loop) -> None:
@@ -2387,6 +3048,9 @@ class BatchedJaxEngine(JaxEngine):
         if req.resume_ids:
             self._admit_resume(req)
             return
+        if self._use_pool:
+            self._admit_one_pool(req)
+            return
         slot_idx = self._slots.index(None)
         t_adm = time.monotonic()
         wait_ms = (t_adm - req.t_submit) * 1000.0
@@ -2547,7 +3211,9 @@ class BatchedJaxEngine(JaxEngine):
                 self._finish(i, "timeout",
                              error=GenerationTimeout("generation timeout"),
                              wasted_inflight=True)
-            elif slot.pos >= self.max_seq_len:
+            elif slot.exhausted or slot.pos >= self.max_seq_len:
+                # Capacity end: KV span reached max_seq, or (pool mode)
+                # block allocation starved even after radix eviction.
                 slot.exhausted = True
                 if slot.chunks_inflight == 0:
                     self._finish(i, "length")
@@ -2561,6 +3227,19 @@ class BatchedJaxEngine(JaxEngine):
                         if s is not None and not s.exhausted]
         if not active_slots:
             return
+        if self._use_pool:
+            # Grow block tables to cover this chunk's writes BEFORE the
+            # dispatch snapshot: decode allocates pages on demand (the
+            # whole point of the pool — a slot holds only the pages its
+            # live span needs). A slot the pool can't serve is marked
+            # exhausted and excluded from this chunk.
+            for i, s in enumerate(self._slots):
+                if s is not None and not s.exhausted:
+                    self._pool_ensure_coverage(i, s)
+            active_slots = [s for s in self._slots
+                            if s is not None and not s.exhausted]
+            if not active_slots:
+                return
         force = jnp.asarray(
             [s is not None and not s.exhausted for s in self._slots],
             jnp.bool_,
@@ -2598,12 +3277,14 @@ class BatchedJaxEngine(JaxEngine):
                     # the one production serving exercises.
                     from ..parallel.sharding import shard_tokens
                     corrupt_d = shard_tokens(corrupt_d, self.mesh)
+        chunk_args = (self.params, self._tok_d, self._pos_d, self._cache,
+                      self._seeds_d, self._temps_d, force, self._active_d,
+                      self._ngen_d, self._budget_d, corrupt_d)
+        if self._use_pool:
+            chunk_args = chunk_args + (jnp.asarray(self._tables),)
         (packed_d, self._tok_d, self._pos_d, self._cache,
          self._active_d, self._ngen_d) = (
-            self._batch_chunk_fns[bucket](
-                self.params, self._tok_d, self._pos_d, self._cache,
-                self._seeds_d, self._temps_d, force, self._active_d,
-                self._ngen_d, self._budget_d, corrupt_d)
+            self._batch_chunk_fns[bucket](*chunk_args)
         )
         snapshot = [
             s.req if s is not None and not s.exhausted else None
@@ -2873,6 +3554,16 @@ class BatchedJaxEngine(JaxEngine):
         self._slots[slot_idx] = None
         if slot is None:  # pragma: no cover - defensive
             return
+        if self._use_pool:
+            # Release the slot's pool blocks; clean finishes insert the
+            # verified chain into the radix tree first, so a finished
+            # agent turn's prompt+completion KV stays shareable for
+            # turn N+1 (refcount-aware: shared blocks just lose this
+            # holder).
+            self._pool_release_slot(
+                slot_idx, slot,
+                cache_chain=(error is None and finish in ("stop",
+                                                          "length")))
         # Host-ONLY finishes (cancel/timeout/first-token EOS) end a slot
         # the device still believes is live: every already-dispatched
         # chunk decodes it to no purpose. Device-visible finishes (EOS /

@@ -35,6 +35,9 @@ from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
                           CAUSE_SLOT_HEALTH, PROBATION_CLEAN_CHUNKS,
                           REASON_HEALTH, REASON_ISOLATED, EngineSupervisor)
 from .fallback import extract_query, rule_command  # rules promoted there
+from .kv_pool import (BlockPool, PoolExhausted, alloc_with_evict,
+                      map_prefix, pages_for)
+from .radix_cache import RadixCache
 from .protocol import (HEALTH_NONFINITE, EngineOverloaded, EngineResult,
                        EngineUnavailable, GenerationTimeout, RequestExport,
                        RequestQuarantined, consume_chunk_row, pack_chunk,
@@ -167,6 +170,12 @@ class _FakeReq:
     resume_cause: str = ""
     t_first0: Optional[float] = None
     ttft_exempt: bool = False
+    # Block-paged KV pool mirror (ISSUE 10): the prompt's token ids in
+    # the fake's word-token encoding — the radix-chain key. Completion
+    # pieces render as "t<id>" words, which encode back to the SAME ids,
+    # so a re-sent multi-turn history radix-matches exactly like real
+    # tokenization does.
+    prompt_ids: List[int] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -179,6 +188,12 @@ class _FakeSlot:
     last_tok: int                 # device carry token (garbage repeats)
     decode_chunks_inflight: int = 0
     t_first: Optional[float] = None   # first token emitted (TTFT SLO)
+    # KV pool mirror: this slot's mapped pool blocks (page order), the
+    # admitted prompt ids (radix-chain basis), and the starvation flag
+    # (pool exhausted even after eviction -> finish at current length).
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    pool_ids: List[int] = dataclasses.field(default_factory=list)
+    pool_starved: bool = False
 
 
 class FakeChunkedEngine:
@@ -212,6 +227,12 @@ class FakeChunkedEngine:
                  slo_ttft_ms: float = 0.0,
                  slo_windows: tuple = (300, 3600),
                  slo_objective: float = 0.99,
+                 kv_pool: bool = True,
+                 kv_pool_page: int = 16,
+                 kv_pool_blocks: int = 0,
+                 radix_cache: bool = True,
+                 radix_lru_blocks: int = 0,
+                 max_seq_len: int = 256,
                  faults=None,
                  stream_fn: Optional[Callable[[str], List[int]]] = None):
         if chunk_pipe_depth < 1:
@@ -271,6 +292,135 @@ class FakeChunkedEngine:
         self._chunks_consumed = 0
         self._chunks_pruned = 0
         self._last_n_alive = 0
+        # Block-paged KV pool mirror (ISSUE 10): the SAME BlockPool /
+        # RadixCache objects and the SAME kv_pool.map_prefix admission
+        # path the batcher runs — the fake's KV is fictional (scripted
+        # streams), but every alloc/incref/decref/COW/insert/evict is
+        # real, so the leak and sharing invariants run in tier-1 on CPU
+        # against production refcount code.
+        self.kv_pool = bool(kv_pool)
+        self.kv_pool_page = max(1, kv_pool_page)
+        self.radix_cache = bool(radix_cache)
+        self.radix_lru_blocks = max(0, radix_lru_blocks)
+        self.max_seq_len = max(chunk_len + 1, max_seq_len)
+        self._pool_max_pages = pages_for(self.max_seq_len + chunk_len,
+                                         self.kv_pool_page)
+        self._pool_n_blocks = (max(0, kv_pool_blocks)
+                               or batch_size * self._pool_max_pages)
+        self._pool: Optional[BlockPool] = None
+        self._radix: Optional[RadixCache] = None
+        self._pool_starved = 0
+        if self.kv_pool:
+            self._pool_reset()
+
+    # ------------------------------------- block-paged KV pool (mirror)
+
+    def _pool_reset(self) -> None:
+        """(Re-)build the allocator world — the fake analog of the
+        batcher's pool rebuild on a containment reset: every cached
+        block's (fictional) KV is invalid, so ownership restarts empty
+        and replays re-allocate. Cumulative counters carry over (the
+        /metrics delta-mirror must never see totals go backwards)."""
+        prev_pool, prev_radix = self._pool, self._radix
+        self._pool = BlockPool(self._pool_n_blocks, self.kv_pool_page)
+        self._radix = (RadixCache(self._pool,
+                                  max_blocks=self.radix_lru_blocks)
+                       if self.radix_cache else None)
+        if prev_pool is not None:
+            self._pool.carry_counters(prev_pool)
+        if prev_radix is not None and self._radix is not None:
+            self._radix.carry_counters(prev_radix)
+
+    @staticmethod
+    def _prompt_token_ids(prompt: str) -> List[int]:
+        """Word-token encoding with the completion round-trip property:
+        the fake's completion pieces are "t<id>" words, which encode
+        back to exactly ``id`` — so a multi-turn prompt that re-sends
+        prompt + completion text extends the cached chain's ids
+        verbatim, and the radix tree matches the whole history (the
+        real tokenizer gives the batcher the same property)."""
+        out = []
+        for w in prompt.split():
+            if len(w) > 1 and w[0] == "t" and w[1:].isdigit():
+                out.append(int(w[1:]))
+            else:
+                out.append(
+                    1_000_000
+                    + zlib.crc32(w.encode("utf-8", "surrogatepass"))
+                    % 1_000_000)
+        return out
+
+    def _pool_map_prefix(self, ids: List[int], match_all: bool = False):
+        """kv_pool.map_prefix — the batcher's exact admission path; the
+        COW callback is None because only the accounting is real here
+        (the copy itself is device work)."""
+        return map_prefix(self._pool, self._radix, ids,
+                          match_all=match_all, cow=None)
+
+    def _pool_seat(self, req: _FakeReq, g: int) -> tuple:
+        """Allocate one seating's chain: the replay basis is
+        prompt + emitted[:-1] (the rows a real device has verifiably
+        written). Returns (blocks, pool_ids); raises PoolExhausted with
+        refs released."""
+        if self._pool is None:
+            return [], []
+        basis = list(req.prompt_ids)
+        gen = list(req.resume_ids or [])[:g]
+        chain = basis + (gen[:-1] if gen else [])
+        blocks, _ = self._pool_map_prefix(chain, match_all=bool(gen))
+        return blocks, basis
+
+    def _pool_ensure_coverage(self, slot: _FakeSlot) -> bool:
+        """Grow the slot's chain to cover the next chunk's writes
+        (mirror of the batcher's dispatch-time growth; starvation
+        truncates the request at its current length, never corrupts)."""
+        if self._pool is None or slot.pool_starved:
+            return not slot.pool_starved
+        target = min(len(slot.pool_ids) + slot.dev_ngen + self.chunk_len,
+                     len(slot.pool_ids) + slot.req.max_tokens)
+        need = pages_for(target, self.kv_pool_page)
+        while len(slot.blocks) < need:
+            b = alloc_with_evict(self._pool, self._radix, 1)
+            if b is None:
+                slot.pool_starved = True
+                self._pool_starved += 1
+                return False
+            slot.blocks.extend(b)
+            if slot.req.export is not None:
+                slot.req.export.blocks = list(slot.blocks)
+        return True
+
+    def _pool_release_slot(self, slot: _FakeSlot,
+                           cache_chain: bool = True) -> None:
+        """Mirror of the batcher's release: clean finishes insert the
+        verified chain (prompt + emitted[:-1]) into the radix tree
+        first — completion feeds sharing — then the slot's refs drop
+        (shared blocks decay to cached, private ones free)."""
+        if self._pool is None or not slot.blocks:
+            slot.blocks = []
+            return
+        if cache_chain and self._radix is not None and slot.pool_ids:
+            chain = slot.pool_ids + (slot.emitted[:-1] if slot.emitted
+                                     else [])
+            chain = chain[:len(slot.blocks) * self.kv_pool_page]
+            try:
+                self._radix.insert(chain, slot.blocks)
+            except Exception:  # pragma: no cover - defensive
+                pass
+        self._pool.decref(slot.blocks)
+        slot.blocks = []
+
+    def kv_pool_health(self) -> Optional[dict]:
+        """Cheap pool view for /health (mirror of the batcher's)."""
+        if self._pool is None:
+            return None
+        cached = (self._radix.cached_blocks() if self._radix is not None
+                  else ())
+        body = self._pool.stats(cached).as_dict()
+        body["starved_slots_total"] = self._pool_starved
+        body["radix"] = (self._radix.stats() if self._radix is not None
+                         else None)
+        return body
 
     # ----------------------------------------------------------- streams
 
@@ -324,6 +474,7 @@ class FakeChunkedEngine:
                 setattr(self, task_attr, None)
         for slot in self._slots:
             if slot is not None:
+                self._pool_release_slot(slot, cache_chain=False)
                 slot.req.out_queue.put_nowait(
                     ("error", EngineUnavailable("engine stopped")))
         self._slots = [None] * self.batch_size
@@ -366,6 +517,7 @@ class FakeChunkedEngine:
             "containment": dict(self.supervisor.stats(),
                                 parked=len(self._parked),
                                 slot_health_check=self.slot_health_check),
+            "kv_pool": self.kv_pool_health(),
             "ledger": self.ledger.snapshot(),
             "slo": self._slo.snapshot(),
         }
@@ -422,6 +574,11 @@ class FakeChunkedEngine:
             survivors = [s for s in self._slots if s is not None]
             self._slots = [None] * self.batch_size
             self._inflight.clear()
+            if self._pool is not None:
+                self._pool_reset()
+                for s in survivors + self._parked:
+                    s.blocks = []
+                    s.pool_starved = False
             if not self.supervisor.allow_reset():
                 self._ready = False
                 err = EngineUnavailable(
@@ -477,6 +634,8 @@ class FakeChunkedEngine:
                 self._finish(i, "timeout",
                              error=GenerationTimeout("generation timeout"),
                              wasted_inflight=True)
+            elif slot.pool_starved and slot.decode_chunks_inflight == 0:
+                self._finish(i, "length")
 
     # --------------------------------------------- QoS ring (ISSUE 7)
 
@@ -565,6 +724,9 @@ class FakeChunkedEngine:
             req.trace.link("preempted", from_slot=idx,
                            tokens=len(slot.emitted), for_lane=for_lane,
                            lane=req.lane)
+        # Pool mirror: cache the victim's chain so its resume re-maps
+        # shared blocks instead of re-prefilling.
+        self._pool_release_slot(slot, cache_chain=True)
         self._queue.requeue_head(req)
 
     def _inject_flood(self, n: int) -> None:
@@ -577,6 +739,7 @@ class FakeChunkedEngine:
             prompt = f"tenant flood drill {i}"
             req = _FakeReq(
                 prompt=prompt,
+                prompt_ids=self._prompt_token_ids(prompt),
                 max_tokens=32,
                 deadline=now + 30.0,
                 out_queue=asyncio.Queue(),
@@ -645,15 +808,27 @@ class FakeChunkedEngine:
                 # device cursors resume at g. The prefix TEXT is
                 # re-emitted only for migrations (the fleet relay
                 # suppresses it); a preempted victim's client already
-                # has it (resume_emitted).
+                # has it (resume_emitted). Pool mirror: the replay basis
+                # (prompt + prefix[:-1]) radix-matches the chain the
+                # preemption cached, so a resume re-MAPS shared blocks
+                # instead of re-prefilling (kv_pool.map_prefix).
                 g = len(req.resume_ids)
+                try:
+                    blocks, basis = self._pool_seat(req, g)
+                except PoolExhausted:
+                    req.out_queue.put_nowait(("error", EngineUnavailable(
+                        "admission failed: kv pool exhausted")))
+                    continue
                 slot = _FakeSlot(
                     req=req, emitted=list(req.resume_ids), dev_idx=g,
                     dev_ngen=g,
                     dev_active=(g < req.max_tokens
                                 if self.device_termination else True),
                     last_tok=req.resume_ids[-1],
-                    t_first=time.monotonic())
+                    t_first=time.monotonic(),
+                    blocks=blocks, pool_ids=basis)
+                if req.export is not None and blocks:
+                    req.export.blocks = list(blocks)
                 if not req.resume_emitted:
                     req.out_queue.put_nowait(
                         ("token", self._piece(slot.emitted, 0)))
@@ -685,10 +860,19 @@ class FakeChunkedEngine:
             if first in self.eos_ids:
                 req.out_queue.put_nowait(("done", self._result(req, [], "stop")))
                 continue
+            try:
+                blocks, basis = self._pool_seat(req, 0)
+            except PoolExhausted:
+                req.out_queue.put_nowait(("error", EngineUnavailable(
+                    "admission failed: kv pool exhausted")))
+                continue
             slot = _FakeSlot(req=req, emitted=[first], dev_idx=1,
                              dev_ngen=1, dev_active=req.max_tokens > 1,
                              last_tok=first,
-                             t_first=time.monotonic())
+                             t_first=time.monotonic(),
+                             blocks=blocks, pool_ids=basis)
+            if req.export is not None and blocks:
+                req.export.blocks = list(blocks)
             if req.t_first0 is None:
                 req.t_first0 = slot.t_first
             if not self.device_termination:
@@ -720,6 +904,13 @@ class FakeChunkedEngine:
         snapshot: List[Optional[_FakeReq]] = [None] * N
         for i, slot in enumerate(self._slots):
             if slot is None:
+                continue
+            if (self._pool is not None
+                    and not self._pool_ensure_coverage(slot)):
+                # Pool starved even after radix eviction: the slot is
+                # excluded from this chunk and finishes at its current
+                # length once its in-flight chunks drain (mirror of the
+                # batcher's exhausted-slot handling).
                 continue
             snapshot[i] = slot.req
             slot.decode_chunks_inflight += 1
@@ -867,9 +1058,14 @@ class FakeChunkedEngine:
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 self._slots[i] = None
+                self._pool_release_slot(slot, cache_chain=False)
                 self._bill_delivered(slot.req, len(slot.emitted))
                 slot.req.out_queue.put_nowait(("error", error))
         for slot in self._parked:
+            # Parked slots' block lists were cleared at the reset that
+            # parked them (stale-generation views) — release is a no-op
+            # there by construction.
+            self._pool_release_slot(slot, cache_chain=False)
             self._bill_delivered(slot.req, len(slot.emitted))
             slot.req.out_queue.put_nowait(("error", error))
         self._parked.clear()
@@ -923,6 +1119,15 @@ class FakeChunkedEngine:
                     reasons[id(slot)] = REASON_ISOLATED
         self._slots = [None] * self.batch_size
         self._inflight.clear()
+        if self._pool is not None:
+            # Mirror the batcher's reset: the pool world rebuilds empty
+            # (cached KV would be device-invalid there), and survivors'
+            # block lists are stale previous-generation views — cleared
+            # so nothing ever decrefs stale ids into the fresh pool.
+            self._pool_reset()
+            for s in survivors:
+                s.blocks = []
+                s.pool_starved = False
         self.supervisor.note_reset(cause)
         qset = {id(s) for s in quarantined}
         for slot in quarantined:
@@ -980,6 +1185,21 @@ class FakeChunkedEngine:
             return
         g = len(slot.emitted)
         i = self._slots.index(None)
+        if self._pool is not None:
+            # Pool mirror of the batcher's replay: re-derive the chain
+            # through the radix tree (a preempt-cached or shared prefix
+            # re-maps; after a reset the empty tree means fresh blocks).
+            chain = slot.pool_ids + (slot.emitted[:-1] if slot.emitted
+                                     else [])
+            try:
+                slot.blocks, _ = self._pool_map_prefix(chain,
+                                                       match_all=True)
+            except PoolExhausted:
+                req.out_queue.put_nowait(("error", EngineUnavailable(
+                    "replay failed: kv pool exhausted")))
+                return
+            if req.export is not None and slot.blocks:
+                req.export.blocks = list(slot.blocks)
         slot.dev_idx = g
         slot.dev_ngen = g
         slot.last_tok = slot.emitted[-1] if slot.emitted else 0
@@ -1003,6 +1223,11 @@ class FakeChunkedEngine:
         self._slots[slot_idx] = None
         if slot is None:  # pragma: no cover - defensive
             return
+        # Pool mirror: release blocks; clean finishes cache the chain
+        # first (completion feeds sharing — same rule as the batcher).
+        self._pool_release_slot(
+            slot, cache_chain=(error is None
+                               and finish in ("stop", "length")))
         # Mirror the batcher's billing: capped by the remaining token
         # budget — the device freezes there, so a disconnect near natural
         # completion can't read as a full pipe of waste.
@@ -1091,6 +1316,7 @@ class FakeChunkedEngine:
         now = time.monotonic()
         req = _FakeReq(
             prompt=prompt,
+            prompt_ids=self._prompt_token_ids(prompt),
             max_tokens=max(1, max_tokens),
             deadline=(now + timeout) if timeout else None,
             out_queue=asyncio.Queue(),

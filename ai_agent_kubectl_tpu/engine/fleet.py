@@ -475,7 +475,14 @@ class EngineFleet:
         """Restart an ejected/drained replica and return it to rotation
         with a clean breaker."""
         rep = self.replicas[idx]
-        if rep.state == REPLICA_ACTIVE:
+        if rep.state == REPLICA_ACTIVE and getattr(rep.engine, "ready",
+                                                   False):
+            # Genuinely healthy — nothing to do. A replica whose engine
+            # was hard-killed but which the monitor has not yet ejected
+            # (debounce) is still state=active with ready=False: the
+            # early return used to skip the restart entirely there,
+            # leaving a dead engine "active" until the monitor caught
+            # up — a rejoin racing the eject must restart it anyway.
             return
         if not getattr(rep.engine, "ready", False):
             try:
@@ -1008,6 +1015,45 @@ class EngineFleet:
                 agg[k] += q.get(k, 0)
         return agg if seen else {}
 
+    def kv_pool_health(self) -> dict:
+        """Fleet rollup of the replicas' KV-pool views (ISSUE 10):
+        block-state counts and sharing/COW/radix counters sum — each
+        replica owns its own pool (block ids are engine-local), so the
+        rollup is capacity accounting, not a shared address space."""
+        agg: dict = {}
+        radix: dict = {}
+        seen = radix_seen = False
+        for rep in self.replicas:
+            fn = getattr(rep.engine, "kv_pool_health", None)
+            if not callable(fn):
+                continue
+            try:
+                p = fn() or None
+            except Exception:   # pragma: no cover - stopped replica
+                continue
+            if not p:
+                continue
+            seen = True
+            for k, v in p.items():
+                if k == "radix":
+                    if v:
+                        radix_seen = True
+                        for rk, rv in v.items():
+                            # Budgets/counts sum; per-replica-identical
+                            # config passes through below.
+                            radix[rk] = radix.get(rk, 0) + rv
+                elif k == "page":
+                    # Config, identical per replica — pass through, a
+                    # sum would triple the "tokens per block" math any
+                    # consumer derives from the rollup.
+                    agg[k] = v
+                elif isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+        if not seen:
+            return {}
+        agg["radix"] = radix if radix_seen else None
+        return agg
+
     def slo_health(self) -> dict:
         """Fleet rollup of the replicas' SLO burn snapshots: per-window
         counts sum, burn rates recompute from the sums (rates don't
@@ -1210,6 +1256,10 @@ class EngineFleet:
         slo = [s["slo"] for s in replica_stats if s.get("slo")]
         if slo:
             agg["slo"] = obs_slo.merge_snapshots(slo)
+        # KV pool (ISSUE 10): block-state counts + sharing/radix
+        # counters sum across replicas (each owns its own pool).
+        if any(s.get("kv_pool") for s in replica_stats):
+            agg["kv_pool"] = self.kv_pool_health() or None
         fleet = self.fleet_health()
         fleet["replicas"] = per_replica
         agg["fleet"] = fleet

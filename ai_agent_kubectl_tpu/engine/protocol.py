@@ -238,6 +238,14 @@ class RequestExport:
     slots."""
 
     ids: List[int] = field(default_factory=list)
+    #: block-paged KV pool (ISSUE 10): the pool block ids this request's
+    #: table currently maps on its engine, updated at admission and at
+    #: every table growth. Block ids are ENGINE-LOCAL (a migration
+    #: target re-derives its own chain via its radix tree — shared
+    #: prefixes re-map instead of re-prefilling); carried here so
+    #: quarantine re-splice, preemption resume, and the debug surfaces
+    #: can see a request's block footprint.
+    blocks: List[int] = field(default_factory=list)
     #: set by the fleet BEFORE cancelling a losing hedge branch: tokens
     #: this dispatch emitted were never forwarded to the client (the
     #: winning branch's bytes were), so the engine's finish accounting

@@ -1,0 +1,353 @@
+"""Radix tree over token prefixes, backed by the block-paged KV pool.
+
+SGLang's RadixAttention sharing model over this repo's TPU pool layout
+(engine/kv_pool.py): the tree maps token prefixes to the pool blocks
+holding their KV, so N concurrent users sharing the system prompt cost
+one block set, and turn N+1 of a multi-turn ``/execute`` agent loop —
+which re-sends its entire history — prefills only the unmatched suffix
+instead of recomputing everything. This replaces the single-resident-
+prefix ``engine/prefix_cache.py`` model in pool mode (the dense KV ladder
+keeps the old PrefixKV splice).
+
+Shape of the tree (page-granular trie + partial tails):
+
+- Every edge is exactly ONE full page of tokens (``page`` ids), keyed by
+  the page's token tuple; the node holds the pool block containing that
+  page's KV. Node boundaries therefore always fall on page multiples, so
+  a matched path maps straight into a slot's block table with zero
+  copying — full blocks are shared read-only (decode never writes below
+  a slot's live length) under one refcount each.
+- A node may additionally hold one *tail*: a partial page (tokens, block,
+  rows) — the remainder of the deepest inserted sequence below that
+  node. A tail match cannot be shared in place (the new owner will write
+  rows into that page as it decodes), so the caller copy-on-writes the
+  matched rows into a fresh block (``BlockPool.note_cow``). One tail per
+  node, latest-wins on divergence: tails exist for the agent-loop resume
+  case, where the newest continuation is the one that returns.
+
+Eviction is refcount-aware block reclamation, not whole-entry deletion:
+the LRU walk drops childless nodes (tails first), decref'ing their blocks
+— a block still mapped by a live slot survives at refcount >= 1 and only
+its *cached* state ends. ``max_blocks`` bounds the tree's held blocks
+(RADIX_LRU_BLOCKS); ``evict_for`` frees pool pressure on demand.
+
+Host-side, numpy/stdlib only; single-writer (scheduler thread / event
+loop) like the pool itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .kv_pool import BlockPool
+
+
+@dataclasses.dataclass
+class MatchResult:
+    """One admission's view of a prefix match.
+
+    ``blocks`` are full shared pages, already incref'd FOR THE CALLER
+    (map them into the slot table as-is). ``tail_block``/``tail_rows``
+    name a partial page whose first ``tail_rows`` KV rows match — also
+    incref'd; the caller must copy those rows into a fresh block and
+    ``decref([tail_block])`` once the copy has executed. ``n_tokens`` =
+    matched tokens total (full pages + tail rows)."""
+
+    n_tokens: int = 0
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    tail_block: Optional[int] = None
+    tail_rows: int = 0
+
+
+class _Node:
+    __slots__ = ("children", "block", "tail", "parent", "key", "last")
+
+    def __init__(self, parent: Optional["_Node"], key: Optional[tuple],
+                 block: Optional[int]):
+        self.children: Dict[tuple, _Node] = {}
+        self.block = block           # pool block of this node's page
+        self.parent = parent
+        self.key = key               # page token tuple (None at root)
+        # (tokens tuple, block id, rows) — the partial page below this
+        # node, or None.
+        self.tail: Optional[Tuple[tuple, int, int]] = None
+        self.last = 0                # LRU stamp (monotonic counter)
+
+
+class RadixCache:
+    def __init__(self, pool: BlockPool, *, max_blocks: int = 0):
+        self.pool = pool
+        self.page = pool.page
+        # 0 = auto: a quarter of the pool may sit cached — enough to keep
+        # the system prompt + recent agent histories hot without starving
+        # live admissions.
+        self.max_blocks = int(max_blocks) if max_blocks > 0 \
+            else max(1, pool.n_blocks // 4)
+        self._root = _Node(None, None, None)
+        self._clock = itertools.count(1)
+        # block id -> number of tree edges holding it (a block can be
+        # cached both as a node's page and as a tail while a sequence
+        # grows through it; each edge carries its own pool ref).
+        self._held: Dict[int, int] = {}
+        # Maintained node counter: /health reads stats() from the HTTP
+        # thread while the scheduler mutates the tree, so the cheap
+        # surfaces must never WALK it (a DFS racing an insert raises
+        # "dict changed size during iteration").
+        self._nodes = 0
+        self.hit_tokens_total = 0
+        self.miss_tokens_total = 0
+        self.insertions_total = 0
+        self.evicted_blocks_total = 0
+
+    def carry_counters(self, prev: "RadixCache") -> None:
+        """Inherit cumulative counters across an engine reset (same
+        rationale as BlockPool.carry_counters — the /metrics
+        delta-mirror must never see totals go backwards)."""
+        self.hit_tokens_total = prev.hit_tokens_total
+        self.miss_tokens_total = prev.miss_tokens_total
+        self.insertions_total = prev.insertions_total
+        self.evicted_blocks_total = prev.evicted_blocks_total
+
+    # ------------------------------------------------------------- match
+
+    def cached_block_count(self) -> int:
+        return len(self._held)          # len() is atomic under the GIL
+
+    def cached_blocks(self) -> Set[int]:
+        """Snapshot of the tree-held block set. Safe to call from a
+        NON-scheduler thread (/health, /metrics): copying a dict's keys
+        while the owner resizes it can raise RuntimeError — retry, and
+        degrade to empty rather than 500 the probe (the scrape is a
+        gauge, not an invariant check)."""
+        for _ in range(4):
+            try:
+                return set(self._held)
+            except RuntimeError:        # pragma: no cover - racy resize
+                continue
+        return set()                    # pragma: no cover - racy resize
+
+    def _hold(self, block: int) -> None:
+        self.pool.incref([block])
+        self._held[block] = self._held.get(block, 0) + 1
+
+    def _release(self, block: int) -> None:
+        n = self._held.get(block, 0) - 1
+        if n <= 0:
+            self._held.pop(block, None)
+        else:
+            self._held[block] = n
+        self.pool.decref([block])
+        self.evicted_blocks_total += 1
+
+    def node_count(self) -> int:
+        return self._nodes              # maintained, never a tree walk
+
+    def match(self, ids: Sequence[int]) -> MatchResult:
+        """Longest cached prefix of ``ids``: full pages walked exactly,
+        then at most one partial-tail match. Matched blocks are incref'd
+        for the caller (see MatchResult). Counters: ``hit_tokens_total``
+        gains the match, ``miss_tokens_total`` the unmatched remainder."""
+        page = self.page
+        node, n = self._root, 0
+        blocks: List[int] = []
+        stamp = next(self._clock)
+        node.last = stamp
+        while len(ids) - n >= page:
+            child = node.children.get(tuple(ids[n:n + page]))
+            if child is None:
+                break
+            blocks.append(child.block)
+            node = child
+            node.last = stamp
+            n += page
+        tail_block, tail_rows = None, 0
+        if node.tail is not None:
+            t_tokens, t_block, t_rows = node.tail
+            limit = min(t_rows, len(ids) - n)
+            common = 0
+            while common < limit and t_tokens[common] == ids[n + common]:
+                common += 1
+            if common > 0:
+                tail_block, tail_rows = t_block, common
+        matched = n + tail_rows
+        self.hit_tokens_total += matched
+        self.miss_tokens_total += len(ids) - matched
+        if blocks:
+            self.pool.incref(blocks)
+            self.pool.note_shared(len(blocks))
+        if tail_block is not None:
+            self.pool.incref([tail_block])
+        return MatchResult(n_tokens=matched, blocks=blocks,
+                           tail_block=tail_block, tail_rows=tail_rows)
+
+    # ------------------------------------------------------------ insert
+
+    def insert(self, ids: Sequence[int], blocks: Sequence[int]) -> int:
+        """Cache the chain ``ids`` whose KV lives in ``blocks`` (block i
+        holds rows [i*page, (i+1)*page) of the sequence; the last block
+        may be partial). The tree takes its OWN refs on blocks it newly
+        caches — the caller's refs are untouched (a finishing slot
+        releases its table afterwards and shared blocks decay to
+        cached). Existing nodes on the path are reused (their resident
+        block stays; the caller's duplicate KV for that page is simply
+        not cached). Returns the number of blocks newly cached."""
+        page = self.page
+        if len(blocks) < pages_needed(len(ids), page):
+            raise ValueError(
+                f"chain of {len(ids)} tokens needs "
+                f"{pages_needed(len(ids), page)} blocks, got {len(blocks)}")
+        node, taken = self._root, 0
+        stamp = next(self._clock)
+        node.last = stamp
+        full = len(ids) // page
+        for i in range(full):
+            key = tuple(ids[i * page:(i + 1) * page])
+            child = node.children.get(key)
+            if child is None:
+                b = blocks[i]
+                self._hold(b)
+                child = _Node(node, key, b)
+                node.children[key] = child
+                self._nodes += 1
+                taken += 1
+            child.last = stamp
+            node = child
+        rows = len(ids) % page
+        if rows:
+            t_tokens = tuple(ids[full * page:])
+            b = blocks[full]
+            cur = node.tail
+            keep_existing = (
+                cur is not None and len(cur[0]) >= rows
+                and cur[0][:rows] == t_tokens)
+            if keep_existing:
+                pass             # the resident tail already covers this one
+            elif cur is not None and cur[1] == b:
+                # Same physical block, longer/different rows (a preempted
+                # slot finishing re-inserts its own tail): the tree's ref
+                # already covers it — just update the view.
+                node.tail = (t_tokens, b, rows)
+            else:
+                self._hold(b)
+                if cur is not None:
+                    self._drop_tail(node)
+                node.tail = (t_tokens, b, rows)
+                taken += 1
+        self.insertions_total += 1
+        self.enforce_budget()
+        return taken
+
+    def _drop_tail(self, node: _Node) -> None:
+        if node.tail is None:
+            return
+        _, b, _ = node.tail
+        node.tail = None
+        self._release(b)
+
+    # ---------------------------------------------------------- eviction
+
+    def _evictables(self) -> List[Tuple[int, int, _Node]]:
+        """(last, kind, node) for every droppable unit, LRU-first. Tails
+        rank before their node's block (kind 0 < 1) so partial pages —
+        the least shareable KV — reclaim first at equal recency; only
+        childless nodes may drop their block (an interior eviction would
+        orphan descendants' chains)."""
+        out: List[Tuple[int, int, _Node]] = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node.tail is not None:
+                out.append((node.last, 0, node))
+            if node is not self._root and not node.children \
+                    and node.tail is None:
+                out.append((node.last, 1, node))
+        out.sort(key=lambda t: (t[0], t[1]))
+        return out
+
+    def _drop_node(self, node: _Node) -> None:
+        del node.parent.children[node.key]
+        self._nodes -= 1
+        self._release(node.block)
+
+    def _evict_until(self, done) -> bool:
+        """Evict strictly-LRU units until ``done()``: one evictables
+        collection seeds a heap, and dropping a node lazily pushes its
+        parent once it becomes childless — O((n + evictions)·log n),
+        not the O(n²) a full re-collect per block would cost on the
+        scheduler hot path, while preserving exact LRU order (a freed
+        leaf's OLDER parent must evict before a younger sibling chain).
+        Returns False once nothing evictable remains."""
+        if done():
+            return True
+        heap = [(last, kind, i, node)
+                for i, (last, kind, node) in enumerate(self._evictables())]
+        heapq.heapify(heap)
+        seq = len(heap)                  # tie-break for lazy pushes
+        while not done():
+            while heap:
+                _, kind, _, node = heapq.heappop(heap)
+                # Staleness: a unit may have been consumed by an earlier
+                # drop in this run (e.g. its tail went first).
+                if kind == 0:
+                    if node.tail is None:
+                        continue
+                    self._drop_tail(node)
+                    if node is not self._root and not node.children:
+                        # The tail was the node's last droppable unit —
+                        # its block itself is evictable now.
+                        heapq.heappush(heap, (node.last, 1, seq, node))
+                        seq += 1
+                else:
+                    if (node.children or node.tail is not None
+                            or node.parent is None
+                            or node.parent.children.get(node.key)
+                            is not node):
+                        continue
+                    parent = node.parent
+                    self._drop_node(node)
+                    if (parent is not self._root and not parent.children
+                            and parent.tail is None):
+                        heapq.heappush(heap,
+                                       (parent.last, 1, seq, parent))
+                        seq += 1
+                break
+            else:
+                return False             # heap drained, target unmet
+        return True
+
+    def enforce_budget(self) -> None:
+        self._evict_until(lambda: len(self._held) <= self.max_blocks)
+
+    def evict_for(self, n_free: int) -> bool:
+        """Free pool pressure: evict LRU cached blocks until the pool has
+        ``n_free`` free blocks or nothing cached remains. Returns True if
+        the target was met. Evicting a block still mapped by a live slot
+        drops only the CACHED state (refcount stays > 0) — it keeps
+        evicting until actual free blocks materialize."""
+        return self._evict_until(lambda: self.pool.free_count >= n_free)
+
+    def clear(self) -> None:
+        """Drop every cached block (engine reset: the pool's device
+        arrays are being rebuilt, so cached KV is invalid)."""
+        self._evict_until(lambda: not self._held and self._nodes == 0)
+        self._root = _Node(None, None, None)
+        self._nodes = 0
+
+    def stats(self) -> dict:
+        return {
+            "nodes": self.node_count(),
+            "cached_blocks": len(self._held),
+            "max_blocks": self.max_blocks,
+            "hit_tokens": self.hit_tokens_total,
+            "miss_tokens": self.miss_tokens_total,
+            "insertions": self.insertions_total,
+            "evicted_blocks": self.evicted_blocks_total,
+        }
+
+
+def pages_needed(n_tokens: int, page: int) -> int:
+    return -(-max(0, n_tokens) // page)

@@ -123,6 +123,54 @@ def init_params(key: jax.Array, cfg: ModelConfig, dtype=jnp.bfloat16) -> Params:
     return params
 
 
+# ----------------------------------------------------- block-paged pool
+#
+# Pool-mode KV (ISSUE 10): the cache leaves are [n_layers, n_blocks,
+# page, KV, hd] — one shared block pool instead of per-slot regions —
+# and a per-slot block table [B, max_pages] maps sequence page p of slot
+# b to pool block tables[b, p]. The helpers below are the only places
+# the indirection lives: writes scatter through the table into the
+# flattened pool (out-of-bounds rows — table sentinel or a False
+# write_mask — drop, exactly like the dense path's OOB trick), reads
+# gather each slot's pages back into the dense [B, kv_limit, KV, hd]
+# view the existing attention backends consume. The TPU fast path skips
+# the gather entirely (ops/paged_attention.py block-table kernel).
+
+
+def _pool_flat_pos(tables, positions, page: int, n_blocks: int,
+                   write_mask) -> jnp.ndarray:
+    """[B, S] flat pool-row index per token; OOB (== n_blocks*page) for
+    unmapped pages and masked rows, which the scatter drops."""
+    pg = positions // page
+    blk = jnp.take_along_axis(tables, pg, axis=1)
+    flat = blk * page + positions % page
+    oob = n_blocks * page
+    flat = jnp.where(blk >= n_blocks, oob, flat)
+    if write_mask is not None:
+        flat = jnp.where(write_mask[:, None], flat, oob)
+    return flat
+
+
+def _pool_scatter(leaf, flat, updates):
+    """Scatter [B, S, ...] updates into a [n_blocks, page, ...] pool leaf
+    at flat row indices (OOB drops)."""
+    nb, page = leaf.shape[0], leaf.shape[1]
+    f = leaf.reshape((nb * page,) + leaf.shape[2:])
+    f = f.at[flat].set(updates.astype(leaf.dtype))
+    return f.reshape(leaf.shape)
+
+
+def _pool_gather(leaf, tables, n_pages: int):
+    """Gather each slot's first ``n_pages`` pages into the contiguous
+    [B, n_pages*page, ...] view dense/flash attention reads. Sentinel
+    table entries clamp to a real block — those positions sit beyond the
+    slot's live length, where the causal mask already excludes them."""
+    idx = jnp.clip(tables[:, :n_pages], 0, leaf.shape[0] - 1)
+    g = leaf[idx]
+    return g.reshape((idx.shape[0], n_pages * leaf.shape[1])
+                     + leaf.shape[2:])
+
+
 # -------------------------------------------------------------- blocks
 
 def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
@@ -189,7 +237,8 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
            positions: jnp.ndarray, kv_limit: int,
            batch_idx: jnp.ndarray,
            token_mask,
-           write_mask=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+           write_mask=None,
+           block_tables=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One transformer block. Returns (h_out, new_layer_k, new_layer_v).
 
     The ``jax.named_scope`` blocks here (and in ``forward``/sampling) are
@@ -217,6 +266,70 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
     with jax.named_scope("rope"):
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
+
+    if block_tables is not None:
+        # Block-paged pool (ISSUE 10): layer_k/v are [n_blocks, page, KV,
+        # hd] pool slices; every KV write and read goes through the
+        # per-slot block table. Same absolute-position semantics as the
+        # dense path — only the storage addressing changes, so pool and
+        # dense transcripts are bit-identical.
+        is_q = isinstance(layer_k, QuantKV)
+        pool_leaf = layer_k.q if is_q else layer_k
+        page, n_blocks = pool_leaf.shape[1], pool_leaf.shape[0]
+        if kv_limit % page:
+            raise ValueError(
+                f"pool kv_limit {kv_limit} not a multiple of page {page}")
+        flat = _pool_flat_pos(block_tables, positions, page, n_blocks,
+                              write_mask)
+        with jax.named_scope("kv_write"):
+            if is_q:
+                qk, qv = kv_quantize(k), kv_quantize(v)
+                layer_k = QuantKV(q=_pool_scatter(layer_k.q, flat, qk.q),
+                                  s=_pool_scatter(layer_k.s, flat, qk.s))
+                layer_v = QuantKV(q=_pool_scatter(layer_v.q, flat, qv.q),
+                                  s=_pool_scatter(layer_v.s, flat, qv.s))
+            else:
+                layer_k = _pool_scatter(layer_k, flat, k)
+                layer_v = _pool_scatter(layer_v, flat, v)
+        n_pages = kv_limit // page
+        kv_pos = jnp.arange(kv_limit)[None, None, :]
+        mask = kv_pos <= positions[:, :, None]
+        with jax.named_scope("attention"):
+            if attn_impl == "paged" and S == 1 and not is_q:
+                # TPU fast path: the block-table pallas kernel reads only
+                # each slot's live pages straight from the pool — no
+                # gathered copy ever materializes.
+                from ..ops.paged_attention import paged_decode_attention_pool
+
+                attn = paged_decode_attention_pool(
+                    q[:, 0], layer_k, layer_v, positions[:, 0],
+                    block_tables, page_size=page)[:, None]
+            elif is_q:
+                attn = dense_attention_quant(
+                    q,
+                    _pool_gather(layer_k.q, block_tables, n_pages),
+                    _pool_gather(layer_k.s, block_tables, n_pages),
+                    _pool_gather(layer_v.q, block_tables, n_pages),
+                    _pool_gather(layer_v.s, block_tables, n_pages),
+                    mask,
+                )
+            else:
+                k_ctx = _pool_gather(layer_k, block_tables, n_pages)
+                v_ctx = _pool_gather(layer_v, block_tables, n_pages)
+                if attn_impl == "flash" and S > 1:
+                    from ..ops.flash_attention import flash_attention_cached
+
+                    attn = flash_attention_cached(q, k_ctx, v_ctx,
+                                                  positions)
+                else:
+                    attn = dense_attention(q, k_ctx, v_ctx, mask)
+        with jax.named_scope("o_proj"):
+            h = h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
+        with jax.named_scope("mlp"):
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
+            mlp = (_moe_mlp(cfg, lp, x, mesh, token_mask, moe_impl)
+                   if cfg.is_moe else _dense_mlp(cfg, lp, x))
+        return h + mlp, layer_k, layer_v
 
     # Write this chunk's K/V into the cache at its absolute positions.
     # (scatter; positions are per-slot absolute indices). Dead rows
@@ -392,6 +505,14 @@ def forward(
                                       # see _layer; ignored on the pipe
                                       # path, whose dead slots keep the
                                       # legacy frozen-position writes)
+    block_tables: Optional[jnp.ndarray] = None,  # [B, max_pages] int32:
+                                      # block-paged pool mode (ISSUE 10) —
+                                      # cache leaves are [L, n_blocks,
+                                      # page, ...] and every KV access
+                                      # routes through the table; entries
+                                      # >= n_blocks are the unmapped-page
+                                      # sentinel (writes drop, reads are
+                                      # causally masked)
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run the model over a token chunk (prefill: S>1; decode: S=1).
 
@@ -420,6 +541,14 @@ def forward(
         if cfg.embed_scale:
             h = h * jnp.asarray(cfg.dim ** 0.5, h.dtype)
 
+    if block_tables is not None and mesh is not None:
+        # The pool is a shared structure across slots — the dense path's
+        # slots-over-``data`` sharding doesn't apply, and the pipe stage
+        # body has no table plumbing. The engine resolves KV_POOL under a
+        # mesh to the dense ladder before ever tracing this.
+        raise NotImplementedError(
+            "block-paged KV does not compose with a serving mesh yet "
+            "(ROADMAP item 4); use the dense KV ladder")
     if mesh is not None and "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
         # Pipeline-parallel serving: the layer stack (params and KV cache
         # sharded over ``pipe`` on the layer axis, parallel/sharding.py)
@@ -445,7 +574,8 @@ def forward(
         def scan_body(h, xs):
             lp, layer_k, layer_v = xs
             h, new_k, new_v = step(h, lp, layer_k, layer_v, positions, kv_limit,
-                                   batch_idx, token_mask, write_mask)
+                                   batch_idx, token_mask, write_mask,
+                                   block_tables)
             return h, (new_k, new_v)
 
         h, (new_k, new_v) = jax.lax.scan(
@@ -462,5 +592,11 @@ def forward(
         else:
             logits = qmatmul(h, params["lm_head"])
 
-    new_lengths = jnp.maximum(cache.lengths, positions.max(axis=1) + 1)
+    if block_tables is not None:
+        # Pool mode: lengths are per-SLOT host truth (the scheduler's
+        # block tables track them); the pool cache's lengths leaf is
+        # [n_blocks]-shaped and structural only.
+        new_lengths = cache.lengths
+    else:
+        new_lengths = jnp.maximum(cache.lengths, positions.max(axis=1) + 1)
     return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v, lengths=new_lengths)

@@ -104,6 +104,103 @@ def _paged_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_scr[...] / l).reshape(H, hd).astype(o_ref.dtype)
 
 
+def _paged_pool_kernel(pos_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
+                       m_scr, l_scr, acc_scr, *, page_size: int,
+                       scale: float, n_pages: int, kv_heads: int):
+    """Block-table variant: identical online-softmax body, but the KV
+    blocks arrive via the table-indirected index map (``tbl_ref`` is
+    consumed there, not here). Kept separate so the contiguous-cache
+    kernel's signature stays frozen."""
+    del tbl_ref
+    _paged_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                  acc_scr, page_size=page_size, scale=scale,
+                  n_pages=n_pages, kv_heads=kv_heads)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "scale", "interpret"),
+)
+def paged_decode_attention_pool(
+    q: jnp.ndarray,            # [N, H, hd]  one decode query per slot
+    k: jnp.ndarray,            # [n_blocks, page, KV, hd]  shared pool
+    v: jnp.ndarray,            # [n_blocks, page, KV, hd]
+    positions: jnp.ndarray,    # [N] int32 absolute query positions
+    block_tables: jnp.ndarray,  # [N, max_pages] int32 pool block per page
+    *,
+    page_size: int = 128,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Block-paged ragged decode attention (ISSUE 10): the kernel shape
+    of ``paged_decode_attention`` extended from "contiguous pages per
+    slot" to block-table indirection — slot n's page p streams from pool
+    block ``block_tables[n, p]``. Pages past a slot's live length clamp
+    to its last live block (repeat fetches elide, ``pl.when`` skips the
+    compute), so cost still tracks live pages per slot. Sentinel table
+    entries (>= n_blocks) additionally clamp to a valid block — they can
+    only be reached by dead pages, whose compute is skipped anyway.
+
+    Returns [N, H, hd]; semantics match dense attention over the
+    gathered per-slot view (models/transformer.py::_pool_gather)."""
+    if pltpu is None:
+        raise NotImplementedError(
+            "paged_decode_attention_pool requires "
+            "jax.experimental.pallas.tpu; use the dense gather path"
+        )
+    N, H, hd = q.shape
+    n_blocks, page, KV, _ = k.shape
+    if page != page_size:
+        raise ValueError(f"pool page {page} != page_size {page_size}")
+    n_pages = block_tables.shape[1]
+    if scale is None:
+        scale = hd ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    G = H // KV
+    pos = positions.astype(jnp.int32)
+    tbl = jnp.clip(block_tables.astype(jnp.int32), 0, n_blocks - 1)
+
+    kernel = functools.partial(
+        _paged_pool_kernel, page_size=page_size, scale=scale,
+        n_pages=n_pages, kv_heads=KV,
+    )
+
+    def q_map(n, p, pos_ref, tbl_ref):
+        return (n, 0, 0)
+
+    def kv_map(n, p, pos_ref, tbl_ref):
+        # Clamp dead pages to the slot's last live page, then indirect
+        # through the table: the repeated block index elides the fetch,
+        # pl.when elides the compute.
+        pp = jnp.minimum(p, pos_ref[n] // page_size)
+        return (tbl_ref[n, pp], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(N, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, hd), q_map),
+            pl.BlockSpec((1, page_size, KV, hd), kv_map),
+            pl.BlockSpec((1, page_size, KV, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, H, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((KV, G, 1), jnp.float32),
+            pltpu.VMEM((KV, G, 1), jnp.float32),
+            pltpu.VMEM((KV, G, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, H, hd), q.dtype),
+        interpret=interpret,
+    )(pos, tbl, q, k, v)
+    return out
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("page_size", "scale", "interpret"),

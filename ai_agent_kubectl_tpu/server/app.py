@@ -884,6 +884,12 @@ async def handle_health(request: web.Request) -> web.Response:
     sh = getattr(svc.engine, "slo_health", None)
     if callable(sh):
         slo = sh() or None
+    # KV pool (ISSUE 10): block-state counts + radix hit rates — cheap
+    # (host counters, never stats()), same rule as qos/fleet/slo.
+    kv_pool = None
+    kph = getattr(svc.engine, "kv_pool_health", None)
+    if callable(kph):
+        kv_pool = kph() or None
     body = HealthResponse(
         status="healthy" if ready and breaker == "closed" else "degraded",
         engine=getattr(svc.engine, "name", "unknown"),
@@ -897,6 +903,7 @@ async def handle_health(request: web.Request) -> web.Response:
         fleet=fleet,
         qos=qos,
         slo=slo,
+        kv_pool=kv_pool,
     )
     # The HTTP status tracks engine readiness alone: an open breaker with
     # the engine process alive still serves (fallback and/or cache), and
@@ -1085,6 +1092,10 @@ async def handle_metrics(request: web.Request) -> web.Response:
             svc.metrics.observe_ledger(stats["ledger"])
         if stats.get("slo"):
             svc.metrics.observe_slo(stats["slo"])
+        # KV pool + radix sharing (ISSUE 10): block-state gauges +
+        # sharing/COW/radix-hit counters — same delta-mirror pattern.
+        if stats.get("kv_pool"):
+            svc.metrics.observe_kv_pool(stats["kv_pool"])
     # Windowed throughput gauge: the batcher's own scheduler-side window
     # when it reports one (counts every finish, including streams), else
     # the service-side window fed by the response handlers.

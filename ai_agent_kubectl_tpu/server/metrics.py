@@ -157,6 +157,43 @@ class Metrics:
             "engine_prefix_cache_hits_total", "Prefix-KV cache hits", registry=r
         )
 
+        # Block-paged KV pool + radix prefix sharing (ISSUE 10,
+        # engine/kv_pool.py + engine/radix_cache.py). ``state`` is the
+        # closed free|live|cached set (live = mapped by >=1 slot,
+        # cached = held only by the radix tree). The cumulative sharing
+        # totals are delta-mirrored from stats()["kv_pool"] at scrape
+        # time like the pipeline/containment counters.
+        self.kv_pool_blocks = Gauge(
+            "kv_pool_blocks",
+            "KV pool blocks by state (free | live | cached)",
+            ["state"],
+            registry=r,
+        )
+        self.kv_blocks_shared = Counter(
+            "kv_blocks_shared_total",
+            "Shared-block mappings handed out by the radix tree "
+            "(a full prefix block mapped into another slot's table)",
+            registry=r,
+        )
+        self.kv_cow_copies = Counter(
+            "kv_cow_copies_total",
+            "Copy-on-write copies of partially-filled tail blocks",
+            registry=r,
+        )
+        self.radix_hit_tokens = Counter(
+            "radix_hit_tokens_total",
+            "Prompt tokens whose KV was served from the radix tree "
+            "(prefill skipped)",
+            registry=r,
+        )
+        self.radix_miss_tokens = Counter(
+            "radix_miss_tokens_total",
+            "Prompt tokens prefilled because no cached prefix covered "
+            "them",
+            registry=r,
+        )
+        self._kv_pool_seen = {"shared": 0, "cow": 0, "hit": 0, "miss": 0}
+
         # Decode-pipeline metrics (ISSUE 4: device-side termination +
         # deep chunk pipelining). Occupancy/config are gauges sampled at
         # scrape; the waste/chunk counters are cumulative scheduler totals
@@ -449,6 +486,27 @@ class Metrics:
                 self._pipe_seen[event] = total
         for s in stats.get("chunk_fetch_secs", ()):
             self.chunk_fetch.observe(s)
+
+    def observe_kv_pool(self, pool: dict) -> None:
+        """Mirror the engine's KV-pool stats (stats()["kv_pool"]) into
+        Prometheus at scrape time — block-state gauges set directly,
+        cumulative sharing/COW/radix totals delta-inc'd like the
+        pipeline/containment mirrors."""
+        for state in ("free", "live", "cached"):
+            self.kv_pool_blocks.labels(state=state).set(pool.get(state, 0))
+        seen = self._kv_pool_seen
+        radix = pool.get("radix") or {}
+        for key, counter, total in (
+                ("shared", self.kv_blocks_shared,
+                 pool.get("shared_mapped_total", 0)),
+                ("cow", self.kv_cow_copies,
+                 pool.get("cow_copies_total", 0)),
+                ("hit", self.radix_hit_tokens, radix.get("hit_tokens", 0)),
+                ("miss", self.radix_miss_tokens,
+                 radix.get("miss_tokens", 0))):
+            if total > seen[key]:
+                counter.inc(total - seen[key])
+                seen[key] = total
 
     def observe_containment(self, stats: dict) -> None:
         """Delta-mirror the engine supervisor's containment totals

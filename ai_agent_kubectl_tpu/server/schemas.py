@@ -105,3 +105,9 @@ class HealthResponse(BaseModel):
     # SLO_TTFT_MS / SLO_INTERACTIVE_MS targets. None = engine without
     # the telemetry plane.
     slo: Optional[Dict[str, Any]] = None
+    # Block-paged KV pool + radix prefix sharing (ISSUE 10,
+    # engine/kv_pool.py): block counts by state (free/live/cached),
+    # sharing + copy-on-write totals, and the radix tree's hit/miss
+    # token counters. None = dense-KV engine (KV_POOL=false, a serving
+    # mesh, or the single-sequence/fake/openai paths).
+    kv_pool: Optional[Dict[str, Any]] = None

@@ -281,7 +281,9 @@ async def phase_7b(batch_size: int, max_seq: int, kv_quant: str,
     t0 = time.monotonic()
     await eng7.start()
     log(f"bench: 7B engine ready in {time.monotonic() - t0:.1f}s")
-    assert eng7._prefix is not None
+    # System-prompt prefix reuse must be armed either way: the dense
+    # ladder's resident PrefixKV, or the pool's radix-cached preload.
+    assert eng7._prefix is not None or eng7._use_pool
 
     ttft7 = await ttft_phase(eng7, n=50, tag="7b")
     ttft7["ttft_device_ms"] = device_ttft_phase(eng7)
@@ -358,6 +360,115 @@ async def phase_pipe7b(batch_size: int, max_seq: int, kv_quant: str,
         "tokens_per_sec_per_chip": round(
             statistics.median(samples) / len(jax.devices()), 2),
     }
+
+
+async def phase_paged7b(batch_size: int, max_seq: int, kv_quant: str,
+                        kv_pool: bool, pool_envelope_bs: int = 0,
+                        agent_loop: bool = False,
+                        chunk_len: int = 16) -> dict:
+    """One rung of the ISSUE 10 kv-pool sweep: serving throughput at the
+    7B geometry with the block-paged pool vs the dense KV ladder, at
+    batch sizes the dense layout cannot even allocate (the acceptance
+    claim: bs 48→192 on the SAME HBM budget). ``pool_envelope_bs`` pins
+    the pool's block count to that many DENSE slots' worth of KV, so a
+    bs=192 pool rung provably runs inside the dense bs=64 envelope.
+
+    ``agent_loop`` instead measures the multi-turn scenario: 3-turn
+    sessions re-sending their whole history each turn — with the radix
+    tree, turn N+1 prefills only the unmatched suffix (incremental
+    prefill), so turn-2/3 TTFT collapses vs the full-prefill baseline."""
+    import jax
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "not on TPU"}
+
+    cfg7 = get_config("gemma-7b-it")
+    tok7, _ = make_tokenizer(cfg7)
+    # Page pinned at 64 (the grid-overhead floor DECODE_ATTN=auto would
+    # pick anyway) so the envelope block count is deterministic.
+    page = 64
+    pool_blocks = 0
+    if kv_pool and pool_envelope_bs:
+        pool_blocks = pool_envelope_bs * (-(-(max_seq + chunk_len) // page))
+    log(f"bench: paged7b rung bs={batch_size} kv_pool={kv_pool} "
+        f"blocks={pool_blocks or 'auto'} agent_loop={agent_loop}")
+    eng = BatchedJaxEngine(
+        cfg7,
+        tokenizer=tok7,
+        dtype="bfloat16",
+        quant="int8",
+        kv_quant=kv_quant,
+        max_seq_len=max_seq,
+        prefill_buckets=(64, 128),
+        batch_size=batch_size,
+        chunk_len=chunk_len,
+        kv_pool=kv_pool,
+        kv_pool_page=page,
+        kv_pool_blocks=pool_blocks,
+    )
+    t0 = time.monotonic()
+    await eng.start()
+    log(f"bench: paged7b engine ready in {time.monotonic() - t0:.1f}s")
+    out = {
+        "model": "gemma-7b-it",
+        "batch_size": batch_size,
+        "max_seq_len": max_seq,
+        "kv_quant": kv_quant,
+        "kv_pool": kv_pool,
+        "kv_pool_blocks": pool_blocks,
+        "pool_envelope_bs": pool_envelope_bs,
+    }
+    if agent_loop:
+        # 8 concurrent 3-turn sessions; each turn re-sends the full
+        # history. Per-turn TTFT medians are the artifact: with the
+        # radix tree, turn 2+ is incremental prefill.
+        from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+
+        turn_ttfts: list = [[], [], []]
+
+        async def session(i: int) -> None:
+            history = render_prompt(f"describe deployment web-{i}")
+            for turn in range(3):
+                t0 = time.monotonic()
+                first = None
+                text = []
+                async for piece in eng.generate_stream(
+                        history, max_tokens=48, temperature=0.0):
+                    if first is None:
+                        first = time.monotonic() - t0
+                    text.append(piece)
+                turn_ttfts[turn].append((first or 0.0) * 1000.0)
+                history = history + "".join(text) + f"\nand turn {turn + 2}?"
+
+        await asyncio.gather(*[session(i) for i in range(8)])
+        pool_stats = eng.stats().get("kv_pool") or {}
+        radix = pool_stats.get("radix") or {}
+        out.update({
+            "agent_loop": True,
+            "ttft_turn_ms": [round(statistics.median(t), 2)
+                             for t in turn_ttfts if t],
+            "radix_hit_tokens": radix.get("hit_tokens", 0),
+            "radix_miss_tokens": radix.get("miss_tokens", 0),
+            "cow_copies": pool_stats.get("cow_copies_total", 0),
+        })
+        await eng.stop()
+        return out
+    samples = await throughput_phase(
+        eng, conc=batch_size, max_tokens=64, rounds=2,
+        tag=f"paged7b-{'pool' if kv_pool else 'dense'}-bs{batch_size}")
+    stats = eng.stats()
+    pool_stats = stats.get("kv_pool") or {}
+    await eng.stop()
+    out.update({
+        "tokens_per_sec_per_chip": round(
+            statistics.median(samples) / len(jax.devices()), 2),
+        "kv_pool_stats": pool_stats or None,
+        "batch_occupancy_peak": stats.get("batch_occupancy", 0),
+    })
+    return out
 
 
 def phase_attr7b(batch_size: int, max_seq: int, kv_quant: str) -> dict:
@@ -593,6 +704,57 @@ def orchestrate() -> dict:
         if sweep:
             extra7["pipe_depth_sweep"] = sweep
 
+        # Block-paged KV pool sweep (ISSUE 10): bs 48→192 on the pool
+        # (block count pinned to the DENSE bs=64 envelope so the rungs
+        # provably share one HBM budget) vs the dense ladder (expected
+        # to stop allocating past its bs=64 rung — a failed dense rung
+        # is the datapoint, not an error), plus the 3-turn agent-loop
+        # phase measuring incremental-prefill TTFT vs full prefill.
+        kv_sweep: dict = {"pool": {}, "dense": {}}
+        for bs in (48, 64, 96, 128, 192):
+            rp = _run_phase(
+                ["--phase", "paged7b", "--bs", str(bs),
+                 "--max-seq", str(extra7["max_seq_len"]),
+                 "--kv-quant", extra7["kv_quant"],
+                 "--kv-pool", "on", "--pool-envelope-bs", "64"],
+                timeout=1800)
+            if rp is not None and "skipped" not in rp:
+                kv_sweep["pool"][f"bs{bs}"] = {
+                    k: rp.get(k) for k in ("tokens_per_sec_per_chip",
+                                           "kv_pool_blocks",
+                                           "kv_pool_stats")}
+            if bs <= 96:
+                rd = _run_phase(
+                    ["--phase", "paged7b", "--bs", str(bs),
+                     "--max-seq", str(extra7["max_seq_len"]),
+                     "--kv-quant", extra7["kv_quant"],
+                     "--kv-pool", "off"],
+                    timeout=1800)
+                kv_sweep["dense"][f"bs{bs}"] = (
+                    {"tokens_per_sec_per_chip":
+                     rd.get("tokens_per_sec_per_chip")}
+                    if rd is not None and "skipped" not in rd
+                    else {"failed": "allocation or start failed "
+                          "(dense ladder capacity ceiling)"})
+        ragent = _run_phase(
+            ["--phase", "paged7b", "--bs", "8",
+             "--max-seq", str(extra7["max_seq_len"]),
+             "--kv-quant", extra7["kv_quant"],
+             "--kv-pool", "on", "--agent-loop"],
+            timeout=1800)
+        if ragent is not None and "skipped" not in ragent:
+            kv_sweep["agent_loop"] = ragent
+        ragent_dense = _run_phase(
+            ["--phase", "paged7b", "--bs", "8",
+             "--max-seq", str(extra7["max_seq_len"]),
+             "--kv-quant", extra7["kv_quant"],
+             "--kv-pool", "off", "--agent-loop"],
+            timeout=1800)
+        if ragent_dense is not None and "skipped" not in ragent_dense:
+            kv_sweep["agent_loop_dense"] = ragent_dense
+        if kv_sweep["pool"] or kv_sweep["dense"]:
+            extra7["kv_pool_sweep"] = kv_sweep
+
     rmoe = _run_phase(["--phase", "moe"], timeout=2400)
 
     r2 = _run_phase(["--phase", "2b"], timeout=2400)
@@ -623,18 +785,26 @@ def orchestrate() -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", choices=["7b", "2b", "moe", "attr7b",
-                                        "pipe7b"],
+                                        "pipe7b", "paged7b"],
                     default=None)
     ap.add_argument("--bs", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=256)
     ap.add_argument("--kv-quant", default="")
     ap.add_argument("--chunk-len", type=int, default=16)
     ap.add_argument("--pipe-depth", type=int, default=3)
+    ap.add_argument("--kv-pool", choices=["on", "off"], default="on")
+    ap.add_argument("--pool-envelope-bs", type=int, default=0)
+    ap.add_argument("--agent-loop", action="store_true")
     ns = ap.parse_args()
 
     if ns.phase == "7b":
         result = asyncio.run(
             phase_7b(ns.bs, ns.max_seq, ns.kv_quant, ns.chunk_len))
+    elif ns.phase == "paged7b":
+        result = asyncio.run(
+            phase_paged7b(ns.bs, ns.max_seq, ns.kv_quant,
+                          ns.kv_pool == "on", ns.pool_envelope_bs,
+                          ns.agent_loop, ns.chunk_len))
     elif ns.phase == "pipe7b":
         result = asyncio.run(
             phase_pipe7b(ns.bs, ns.max_seq, ns.kv_quant, ns.pipe_depth,

@@ -77,6 +77,10 @@ def _mk(**kw):
         batch_size=4,
         chunk_len=4,
         compile_cache_dir="",
+        # The dense group-admission scratch is what this suite tests;
+        # pool mode retires that machinery (suffixes prefill straight
+        # into blocks — ISSUE 10, covered by tests/test_kv_pool.py).
+        kv_pool=False,
     )
     defaults.update(kw)
     return BatchedJaxEngine(get_config("toy-8m"), **defaults)

@@ -274,10 +274,14 @@ async def test_group_admission_burst_parity():
     from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
 
     def mk_engine():
+        # kv_pool=False on purpose: this test exercises the DENSE
+        # group-admission scratch path. Pool mode has no group scratch —
+        # suffixes prefill directly into freshly allocated blocks
+        # (ISSUE 10), which tests/test_kv_pool.py covers.
         return BatchedJaxEngine(
             get_config("toy-8m"), tokenizer=ByteTokenizer(), dtype="float32",
             max_seq_len=768, prefill_buckets=(64, 128, 512),
-            prefix_cache=True, batch_size=8, chunk_len=4)
+            prefix_cache=True, batch_size=8, chunk_len=4, kv_pool=False)
 
     queries = ["list pods", "get deployments -o wide",
                "describe node worker-1", "scale deployment web to 3",

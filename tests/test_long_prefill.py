@@ -98,7 +98,13 @@ async def test_background_warm_compiles_chunked_prefill_ladder(cls,
     suffix programs _prefill_chunked dispatches, so the first long prompt
     pays device time, not ~19–65 s of serial compiles (measured cold on
     the r4 bench chip at max_seq 4096)."""
-    kw = {"batch_size": 2, "chunk_len": 4} if cls is BatchedJaxEngine else {}
+    # kv_pool=False for the batcher: the dense warm thread (and the
+    # _suffix_prefill_fns ladder it compiles) is what this test covers;
+    # pool mode has no scratch ladder — its per-shape prefill programs
+    # compile lazily under the watchdog's admission grace and long
+    # prompts are exercised by test_kv_pool.py.
+    kw = ({"batch_size": 2, "chunk_len": 4, "kv_pool": False}
+          if cls is BatchedJaxEngine else {})
     eng = _mk(cls, (32, 64), compile_cache_dir="", **kw)
     await eng.start()
     try:

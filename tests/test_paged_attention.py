@@ -29,6 +29,37 @@ def _rand(N, S, H, KV, hd, seed=0, dtype=jnp.float32):
 
 
 @pytest.mark.parametrize("kv_heads", [1, 2])   # MQA and GQA
+def test_paged_pool_block_table_matches_dense(kv_heads):
+    """Block-table variant (ISSUE 10): slots read scattered pool blocks
+    by table indirection; shared blocks (one block in two tables) and
+    sentinel entries beyond the live span must not change the math vs
+    dense attention over the gathered per-slot view."""
+    from ai_agent_kubectl_tpu.ops.paged_attention import (
+        paged_decode_attention_pool)
+
+    N, n_blocks, page, H, hd = 3, 10, 16, 4, 64
+    KV = kv_heads
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (N, H, hd), jnp.float32)
+    kp = jax.random.normal(ks[1], (n_blocks, page, KV, hd), jnp.float32)
+    vp = jax.random.normal(ks[2], (n_blocks, page, KV, hd), jnp.float32)
+    # Slot 0 and 1 SHARE block 7 as their first page (radix sharing);
+    # dead pages carry the sentinel (n_blocks), which must clamp.
+    tables = jnp.asarray([[7, 2, 9, 10], [7, 5, 10, 10], [0, 1, 3, 4]],
+                         jnp.int32)
+    positions = jnp.asarray([40, 17, 63], jnp.int32)
+    out = paged_decode_attention_pool(q, kp, vp, positions, tables,
+                                      page_size=page, interpret=True)
+    # Reference: gather each slot's pages densely, mask causally.
+    idx = jnp.clip(tables, 0, n_blocks - 1)
+    kg = kp[idx].reshape(N, 4 * page, KV, hd)
+    vg = vp[idx].reshape(N, 4 * page, KV, hd)
+    ref = _dense_ref(q, kg, vg, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2])   # MQA and GQA
 def test_paged_matches_dense_ragged(kv_heads):
     N, S, H, hd, page = 4, 128, 4, 64, 16
     q, k, v = _rand(N, S, H, kv_heads, hd)

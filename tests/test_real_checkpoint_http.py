@@ -80,9 +80,18 @@ async def test_converted_checkpoint_and_bpe_tokenizer_through_http(tmp_path):
         n_prompt = len(engine.tokenizer.encode(render_prompt("list all pods")))
         assert n_prompt < 120, n_prompt
 
-        # The prefix-KV cache keys on the BPE-tokenized system prompt.
-        assert engine._prefix is not None
-        assert engine._prefix.n < 80
+        # The system-prompt KV is resident either way: the dense path's
+        # PrefixKV, or (pool mode, the default) the radix-cached preload
+        # keyed on the same BPE-tokenized system prompt.
+        if engine._use_pool:
+            from ai_agent_kubectl_tpu.engine.prompts import SYSTEM_PROMPT
+
+            assert engine._radix is not None
+            assert engine._radix.cached_block_count() > 0
+            assert len(engine.tokenizer.encode(SYSTEM_PROMPT)) < 80
+        else:
+            assert engine._prefix is not None
+            assert engine._prefix.n < 80
 
         # Random weights produce garbage text, so /kubectl-command may
         # legitimately 422 (unsafe-output) — but the whole path must run:

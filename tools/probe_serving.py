@@ -131,6 +131,7 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
     if consumed:
         log(f"  wasted steps / consumed chunk {wasted / consumed:>8.2f}")
     print_containment_summary(gauges)
+    print_kv_pool_summary(gauges)
     print_fleet_summary(gauges)
     print_qos_summary(gauges)
     print_goodput_summary(gauges)
@@ -169,6 +170,34 @@ def print_containment_summary(gauges: Dict[str, float]) -> None:
     log(f"  slot health trips total     {trips or 0:>8.0f}")
     log(f"  replayed tokens total       "
         f"{gauges.get('replayed_tokens_total', 0.0):>8.0f}")
+
+
+def print_kv_pool_summary(gauges: Dict[str, float]) -> None:
+    """Block-paged KV pool + radix sharing (ISSUE 10) from the same
+    /metrics scrape: pool occupancy by block state, sharing/COW totals,
+    and the radix hit rate (tokens served from cached prefixes vs
+    prefilled)."""
+    states = _sum_labelled(gauges, "kv_pool_blocks")
+    if not states:
+        return      # dense-KV engine (KV_POOL=false / mesh / no batcher)
+    total = sum(states.values())
+    log("probe[kv_pool]: block-paged KV pool")
+    log(f"  pool blocks total           {total:>8.0f}"
+        + (f"  ({', '.join(f'{k}={v:.0f}' for k, v in sorted(states.items()))})"
+           if states else ""))
+    if total:
+        free = states.get('state="free"', 0.0)
+        log(f"  pool occupancy              {(total - free) / total:>8.1%}")
+    log(f"  shared block mappings total "
+        f"{gauges.get('kv_blocks_shared_total', 0.0):>8.0f}")
+    log(f"  copy-on-write copies total  "
+        f"{gauges.get('kv_cow_copies_total', 0.0):>8.0f}")
+    hit = gauges.get("radix_hit_tokens_total", 0.0)
+    miss = gauges.get("radix_miss_tokens_total", 0.0)
+    log(f"  radix hit tokens total      {hit:>8.0f}")
+    log(f"  radix miss tokens total     {miss:>8.0f}")
+    if hit + miss:
+        log(f"  radix hit rate              {hit / (hit + miss):>8.1%}")
 
 
 def print_fleet_summary(gauges: Dict[str, float]) -> None:

@@ -45,12 +45,28 @@ def allreduce_ms(n: int, nbytes: float, ici_gbps: float,
             + 2.0 * (n - 1) * latency_us * 1e-3)
 
 
+def kv_mb_effective(a) -> float:
+    """KV HBM per admitted slot. Dense: every slot owns a full
+    S_alloc-deep region (kv_mb_per_slot). Pool (ISSUE 10): a slot holds
+    only the pages its live span needs — avg_tokens of S_alloc — and the
+    shared radix prefix (system prompt + reused histories) is counted
+    ONCE fleet-wide, not per slot, so the per-slot marginal cost is the
+    UNSHARED span only."""
+    if not a.kv_pool:
+        return a.kv_mb_per_slot
+    unshared = max(1, a.avg_tokens - a.shared_prefix_tokens)
+    return a.kv_mb_per_slot * unshared / a.s_alloc
+
+
 def project(a) -> dict:
     residual0 = a.step_ms - a.weights_ms - a.attn_ms
     if residual0 < 0:
         raise SystemExit("step_ms must exceed weights_ms + attn_ms")
     hbm_free = (a.hbm_gb - a.reserve_gb - a.weights_gb / a.tp)
-    bs_max = int(hbm_free * 1e3 * a.tp / a.kv_mb_per_slot)
+    kv_mb = kv_mb_effective(a)
+    prefix_mb = (a.kv_mb_per_slot * a.shared_prefix_tokens / a.s_alloc
+                 if a.kv_pool else 0.0)
+    bs_max = int((hbm_free * 1e3 * a.tp - prefix_mb) / kv_mb)
     rows = []
     for f in a.f_list:
         for bs in a.batch_list:
@@ -68,7 +84,7 @@ def project(a) -> dict:
                 "fits_hbm": bs <= bs_max,
             })
     return {"residual0_ms": round(residual0, 2), "bs_max_hbm": bs_max,
-            "rows": rows}
+            "kv_mb_per_slot_effective": round(kv_mb, 2), "rows": rows}
 
 
 def render(a, out: dict) -> str:
@@ -77,8 +93,14 @@ def render(a, out: dict) -> str:
         f"(weights {a.weights_ms} ms, attention {a.attn_ms} ms, residual "
         f"{out['residual0_ms']} ms), {a.layers}×2 all-reduces of "
         f"[bs, {a.dim}] bf16 at {a.ici_gbps} GB/s + {a.ici_latency_us} µs "
-        f"ICI; g={a.g} of the residual scales with batch; KV-pool batch "
-        f"ceiling ≈ {out['bs_max_hbm']} slots "
+        f"ICI; g={a.g} of the residual scales with batch; "
+        + (f"block-paged KV (ISSUE 10): {out['kv_mb_per_slot_effective']}"
+           f" MB marginal KV/slot (avg {a.avg_tokens} live of "
+           f"{a.s_alloc} rows, {a.shared_prefix_tokens} radix-shared), "
+           if a.kv_pool else
+           f"dense KV: {a.kv_mb_per_slot} MB/slot (every slot owns "
+           f"S_alloc={a.s_alloc} rows), ")
+        + f"batch ceiling ≈ {out['bs_max_hbm']} slots "
         f"({a.hbm_gb}−{a.reserve_gb} GB HBM − weights/{a.tp}).",
         "",
         "| residual TP-frac f | bs | step ms | all-reduce ms | tok/s/chip |",
@@ -117,6 +139,20 @@ def main() -> int:
     ap.add_argument("--kv-mb-per-slot", type=float, default=47.7,
                     help="int8 KV bytes per slot at S_alloc=208 "
                          "(28L×208×16×256×2)")
+    ap.add_argument("--kv-pool", choices=["on", "off"], default="on",
+                    help="block-paged KV accounting (ISSUE 10): slots "
+                         "pay only their live, unshared pages; off = "
+                         "the dense per-slot S_alloc regions")
+    ap.add_argument("--s-alloc", type=int, default=208,
+                    help="allocated rows per slot the dense layout pays")
+    ap.add_argument("--avg-tokens", type=int, default=144,
+                    help="measured average live rows per slot (prompt + "
+                         "generated) the pool actually allocates — the "
+                         "kubectl workload's bench median (~80 prompt + "
+                         "64 budget)")
+    ap.add_argument("--shared-prefix-tokens", type=int, default=64,
+                    help="radix-shared prefix rows (system prompt + "
+                         "reused history) counted once, not per slot")
     ap.add_argument("--g", type=float, default=0.5,
                     help="fraction of the residual that scales with batch "
                          "(per-slot work: KV writes, sampling rows; the "
@@ -127,6 +163,7 @@ def main() -> int:
     a = ap.parse_args()
     a.f_list = [float(x) for x in a.f_list.split(",")]
     a.batch_list = [int(x) for x in a.batch_list.split(",")]
+    a.kv_pool = a.kv_pool == "on"
 
     if a.attribution:
         with open(a.attribution) as f:
