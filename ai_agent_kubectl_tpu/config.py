@@ -221,6 +221,26 @@ class ServiceConfig:
     # LRU budget (blocks) the radix tree may keep cached. 0 = auto
     # (a quarter of the pool).
     radix_lru_blocks: int = 0               # RADIX_LRU_BLOCKS
+    # --- grammar-constrained decoding (ISSUE 11; constrain/) ---
+    # Compile the kubectl grammar against the tokenizer into a token
+    # FSM, mask logits device-side so only grammar-legal tokens can be
+    # sampled (unsafe commands become unrepresentable, not merely
+    # rejected), and fast-forward forced runs (single-successor chains)
+    # as one suffix prefill instead of decoding token-by-token.
+    # Requires DEVICE_TERMINATION (the FSM state word rides the decode
+    # chunk's carry). Default off: A/B parity with unconstrained decode
+    # is the acceptance gate.
+    grammar_decode: bool = False            # GRAMMAR_DECODE
+    # Base grammar profile: "default" (read-only + mutating verbs),
+    # "readonly" (observation only — also what a background-tier tenant
+    # is clamped to per request), or "permissive" (mask-everything A/B:
+    # grammar plumbing active, language unconstrained).
+    grammar_profile: str = "default"        # GRAMMAR_PROFILE
+    # Minimum NET forced-run length worth a fast-forward splice: the
+    # scheduler only splices when the forced chain exceeds what the
+    # in-flight speculative chunks would decode anyway (their compute
+    # is sunk; discarding them must buy more than it costs).
+    grammar_forced_run_min: int = 4         # GRAMMAR_FORCED_RUN_MIN
     hbm_prefix_cache: bool = True           # HBM_PREFIX_CACHE (system-prompt prefix KV)
     # Scheduler watchdog: if the batch scheduler makes no progress for this
     # long while work is in flight (hung device dispatch), the engine is
@@ -427,6 +447,29 @@ class ServiceConfig:
             raise ValueError(
                 f"RADIX_LRU_BLOCKS must be >= 0 (0 = auto), "
                 f"got {self.radix_lru_blocks}")
+        # Grammar knobs (ISSUE 11): a typo'd profile or an impossible
+        # mode combination must refuse to boot, not silently serve
+        # unconstrained output behind a knob that says otherwise.
+        from .constrain.runtime import PROFILES
+
+        if self.grammar_profile not in PROFILES:
+            raise ValueError(
+                f"GRAMMAR_PROFILE must be one of {PROFILES}, "
+                f"got {self.grammar_profile!r}")
+        if self.grammar_forced_run_min < 1:
+            raise ValueError(
+                f"GRAMMAR_FORCED_RUN_MIN must be >= 1, "
+                f"got {self.grammar_forced_run_min}")
+        if self.grammar_decode and not self.device_termination:
+            raise ValueError(
+                "GRAMMAR_DECODE requires DEVICE_TERMINATION=true (the "
+                "FSM state word rides the decode chunk's carry)")
+        if self.grammar_decode:
+            # Boot-time cross-check (defense-in-depth satellite): every
+            # safety-blocked verb must be absent from every profile.
+            from .constrain import assert_safety_consistent
+
+            assert_safety_consistent()
 
     @property
     def tenant_tier_map(self) -> dict:
@@ -504,6 +547,10 @@ class ServiceConfig:
             kv_pool_blocks=_env_int("KV_POOL_BLOCKS", 0),
             radix_cache=_env_bool("RADIX_CACHE", True),
             radix_lru_blocks=_env_int("RADIX_LRU_BLOCKS", 0),
+            grammar_decode=_env_bool("GRAMMAR_DECODE", False),
+            grammar_profile=(_env_str("GRAMMAR_PROFILE", "default")
+                             or "default").lower(),
+            grammar_forced_run_min=_env_int("GRAMMAR_FORCED_RUN_MIN", 4),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
             engine_watchdog_secs=_env_float("ENGINE_WATCHDOG_SECS", 120.0),
             engine_startup_grace_secs=_env_float(

@@ -61,7 +61,8 @@ from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
 from .jax_engine import JaxEngine
 from .kv_pool import (BlockPool, alloc_with_evict, map_prefix, pages_for)
 from .radix_cache import RadixCache
-from .protocol import (HEALTH_NONFINITE, HEALTH_TOKEN_RANGE, EngineOverloaded,
+from .protocol import (HEALTH_GRAMMAR_DEAD, HEALTH_NONFINITE,
+                       HEALTH_TOKEN_RANGE, EngineOverloaded,
                        EngineResult, EngineUnavailable, GenerationTimeout,
                        RequestExport, RequestQuarantined, TenantOverloaded,
                        consume_chunk_row, describe_health, pack_chunk,
@@ -116,7 +117,9 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
                               vocab_size: int = 0,
                               health_check: bool = True,
                               finalize=lambda arr: arr,
-                              pool_tables: bool = False):
+                              pool_tables: bool = False,
+                              grammar: bool = False,
+                              grammar_s_max: int = 0):
     """Build THE device-termination decode-chunk body: a ``lax.scan`` of
     ``chunk_len`` steps whose carry folds EOS + per-slot token budgets
     into the live mask (finished slots stop sampling, KV writes, and
@@ -141,15 +144,42 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
     supplies the model call (the engine closes over kv_limit/mesh/attn
     impl per KV bucket; attribution closes over its own); ``finalize``
     post-processes the packed buffer (the engine pins it replicated
-    under a mesh)."""
+    under a mesh).
+
+    Grammar-constrained decoding (ISSUE 11, ``grammar=True``): the
+    carry grows a per-slot FSM state word ``gs`` (global state =
+    ``profile_id * grammar_s_max + local_state``, constrain/runtime.py)
+    and the dispatch passes the stacked grammar tables
+    (``tok_class [P, V]``, ``class_ok/class_next [P*S, C]``) as plain
+    arguments — variant installs update table CONTENTS, never the
+    program. Each step gathers the current states' legality rows into a
+    ``[N, vocab]`` mask, freezes dead-end slots via
+    ``HEALTH_GRAMMAR_DEAD`` (no legal token — the quarantine lane's
+    job, not a garbage emission), samples only over the masked support
+    (same key stream, renormalized — engine/sampling.py), and advances
+    the state word by the sampled token's class."""
 
     def batched_chunk_impl(params, tok, pos, cache, seeds, temps, force,
-                           active, ngen, budget, corrupt, tables=None):
+                           active, ngen, budget, corrupt, tables=None,
+                           gs=None, g_tok_class=None, g_ok=None,
+                           g_next=None):
         live0 = jnp.logical_and(active, force)
         health0 = jnp.zeros_like(ngen)
+        tc = None
+        if grammar:
+            # Per-slot token→class rows, hoisted OUT of the scan: the
+            # profile id is chunk-invariant (class_next maps every
+            # state within its own profile block and frozen rows keep
+            # gs), and a carry-derived gather would re-materialize
+            # [batch, vocab] int32 every step on the hottest loop.
+            tc = g_tok_class[gs // grammar_s_max]
 
         def body(carry, _):
-            tok, pos, cache, live, ngen, health = carry
+            if grammar:
+                tok, pos, cache, live, ngen, health, gs = carry
+            else:
+                tok, pos, cache, live, ngen, health = carry
+                gs = None
             if tables is None:
                 logits, cache = forward_step(params, tok, pos, cache, live)
             else:
@@ -162,9 +192,24 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
             step_logits = logits[:, 0]
             step_logits = jnp.where(corrupt[:, None],
                                     jnp.float32(jnp.nan), step_logits)
+            mask = None
+            if grammar:
+                with jax.named_scope("grammar_mask"):
+                    # Per-slot legality over the vocab: the state's
+                    # class-legality row expanded through the profile's
+                    # (hoisted) token→class map. A state with NO legal
+                    # token is a dead end: freeze the slot on the
+                    # grammar health bit before anything is emitted.
+                    mask = jnp.take_along_axis(g_ok[gs], tc, axis=1)
+                    dead = jnp.logical_and(
+                        live, jnp.logical_not(jnp.any(mask, axis=-1)))
+                    health = health | jnp.where(
+                        dead, HEALTH_GRAMMAR_DEAD, 0)
+                    live = jnp.logical_and(live,
+                                           jnp.logical_not(dead))
             nxt = sample_tokens_seeded(step_logits, seeds, ngen, temps,
                                        top_k=top_k, top_p=top_p,
-                                       active=live)
+                                       active=live, mask=mask)
             # Termination fold — a handful of [N]-vector compares the
             # attribution tool bills with the sampling chain.
             with jax.named_scope("sampling"):
@@ -185,6 +230,15 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
                             jnp.logical_and(live, bad_tok),
                             HEALTH_TOKEN_RANGE, 0)
                     live = jnp.logical_and(live, health == 0)
+                if grammar:
+                    # Advance the FSM by the sampled token's class for
+                    # every row that really sampled this step (frozen
+                    # rows keep their state; the EOS class self-loops
+                    # so a terminating row parks in place).
+                    cls = jnp.take_along_axis(
+                        tc, jnp.clip(nxt, 0, tc.shape[1] - 1)[:, None],
+                        axis=1)[:, 0]
+                    gs = jnp.where(live, g_next[gs, cls], gs)
                 nxt = jnp.where(live, nxt, tok[:, 0])
                 hit_eos = jnp.logical_and(eos_mask(nxt, eos_ids), live)
                 counted = jnp.logical_and(live, jnp.logical_not(hit_eos))
@@ -193,16 +247,50 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
                     hit_eos, jnp.logical_and(counted, ngen >= budget))
                 live = jnp.logical_and(live, jnp.logical_not(done_now))
                 pos = pos + counted.astype(jnp.int32)[:, None]
+            if grammar:
+                return (nxt[:, None], pos, cache, live, ngen, health,
+                        gs), nxt
             return (nxt[:, None], pos, cache, live, ngen, health), nxt
 
-        (tok, pos, cache, live, ngen, health), toks = jax.lax.scan(
-            body, (tok, pos, cache, live0, ngen, health0), None,
-            length=chunk_len)
+        carry0 = (tok, pos, cache, live0, ngen, health0)
+        if grammar:
+            carry0 = carry0 + (gs,)
+        carry, toks = jax.lax.scan(body, carry0, None, length=chunk_len)
+        if grammar:
+            tok, pos, cache, live, ngen, health, gs = carry
+        else:
+            tok, pos, cache, live, ngen, health = carry
         toks = jnp.swapaxes(toks, 0, 1)
         done = jnp.logical_and(force, jnp.logical_not(live))
         packed = finalize(pack_chunk(toks, done, ngen, jnp.sum(live),
                                      health=health, xp=jnp))
-        return packed, tok, pos, cache, live, ngen
+        out = (packed, tok, pos, cache, live, ngen)
+        if grammar:
+            out = out + (gs,)
+        return out
+
+    if pool_tables and grammar:
+        def batched_chunk_pool_grammar(params, tok, pos, cache, seeds,
+                                       temps, force, active, ngen,
+                                       budget, corrupt, tables, gs,
+                                       g_tok_class, g_ok, g_next):
+            return batched_chunk_impl(params, tok, pos, cache, seeds,
+                                      temps, force, active, ngen, budget,
+                                      corrupt, tables, gs, g_tok_class,
+                                      g_ok, g_next)
+
+        return batched_chunk_pool_grammar
+
+    if grammar:
+        def batched_chunk_grammar(params, tok, pos, cache, seeds, temps,
+                                  force, active, ngen, budget, corrupt,
+                                  gs, g_tok_class, g_ok, g_next):
+            return batched_chunk_impl(params, tok, pos, cache, seeds,
+                                      temps, force, active, ngen, budget,
+                                      corrupt, None, gs, g_tok_class,
+                                      g_ok, g_next)
+
+        return batched_chunk_grammar
 
     if pool_tables:
         def batched_chunk_pool(params, tok, pos, cache, seeds, temps,
@@ -315,6 +403,11 @@ class _Request:
     # recipient-side sample would overstate.
     t_first0: Optional[float] = None
     ttft_exempt: bool = False
+    # Grammar-constrained decoding (ISSUE 11): the resolved grammar
+    # profile id (constrain/runtime.py — base profile, tenant-tier
+    # readonly clamp, or an installed allowed-verbs variant). -1 =
+    # unconstrained (GRAMMAR_DECODE off).
+    gpid: int = -1
 
 
 @dataclasses.dataclass
@@ -351,6 +444,13 @@ class _Slot:
     # whose table snapshot could write them has retired.
     blocks: Optional[List[int]] = None
     pool_ids: Optional[List[int]] = None
+    # Grammar-constrained decoding (ISSUE 11): host-truth FSM state
+    # over the CONSUMED token stream (the device carries its own
+    # speculative _fsm_d), and the count of in-flight chunks whose rows
+    # a forced-run fast-forward spliced over — their token indexing is
+    # pre-splice, so consume skips exactly that many entries (FIFO).
+    gs: int = 0
+    stale_chunks: int = 0
 
 
 class BatchedJaxEngine(JaxEngine):
@@ -365,6 +465,9 @@ class BatchedJaxEngine(JaxEngine):
                  kv_pool_blocks: int = 0,
                  radix_cache: bool = True,
                  radix_lru_blocks: int = 0,
+                 grammar_decode: bool = False,
+                 grammar_profile: str = "default",
+                 grammar_forced_run_min: int = 4,
                  watchdog_secs: float = 120.0,
                  startup_grace_secs: float = 900.0,
                  admit_scratch_mb: int = 512,
@@ -443,6 +546,24 @@ class BatchedJaxEngine(JaxEngine):
         self._radix: Optional[RadixCache] = None
         self._pool_prefill_fns: dict = {}   # (bucket, kv_limit) -> jitted
         self._pool_starved = 0        # slots truncated by pool exhaustion
+        # Grammar-constrained decoding (ISSUE 11): the kubectl token
+        # FSM masks sampling device-side and forced runs fast-forward
+        # as suffix prefills. Requires device termination (the FSM
+        # state word rides the chunk carry).
+        if grammar_decode and not device_termination:
+            raise ValueError("GRAMMAR_DECODE requires DEVICE_TERMINATION")
+        self.grammar_decode = bool(grammar_decode)
+        self.grammar_profile = grammar_profile
+        self.grammar_forced_run_min = max(1, grammar_forced_run_min)
+        self._grammar = None          # GrammarRuntime, built at start
+        self._grammar_version = -1    # device-table upload generation
+        self._gram_tc_d = self._gram_ok_d = self._gram_next_d = None
+        # Cumulative grammar counters (scheduler-thread writes, scrape
+        # reads — delta-mirrored like the pipeline totals).
+        self._grammar_forced = 0      # tokens delivered by splices
+        self._grammar_masked = 0      # tokens sampled under a mask
+        self._grammar_dead_ends: dict = {}   # cause -> count
+        self._grammar_ff_splices = 0  # fast-forward splice events
         self.watchdog_secs = watchdog_secs
         # Cold-start grace (VERDICT r5 weak #4): until the scheduler has
         # consumed its first pipeline entry — and whenever an admission is
@@ -618,6 +739,9 @@ class BatchedJaxEngine(JaxEngine):
             kv_pool_blocks=cfg.kv_pool_blocks,
             radix_cache=cfg.radix_cache,
             radix_lru_blocks=cfg.radix_lru_blocks,
+            grammar_decode=cfg.grammar_decode,
+            grammar_profile=cfg.grammar_profile,
+            grammar_forced_run_min=cfg.grammar_forced_run_min,
             watchdog_secs=cfg.engine_watchdog_secs,
             startup_grace_secs=cfg.engine_startup_grace_secs,
             admit_scratch_mb=cfg.admit_scratch_mb,
@@ -657,6 +781,25 @@ class BatchedJaxEngine(JaxEngine):
             logger.warning(
                 "KV_POOL does not compose with a serving mesh yet; "
                 "falling back to the dense KV ladder")
+        if self.grammar_decode:
+            # Grammar runtime (ISSUE 11): compile the kubectl grammar
+            # against THIS tokenizer. Host numpy truth; the stacked
+            # fixed-shape tables upload to device at dispatch time
+            # (refreshed whenever a per-request variant installs).
+            from ..constrain import GrammarRuntime, assert_safety_consistent
+
+            assert_safety_consistent()
+            self._grammar = GrammarRuntime(
+                self.tokenizer, self.model_cfg.vocab_size,
+                self.model_cfg.eos_ids, profile=self.grammar_profile,
+                forced_run_min=self.grammar_forced_run_min)
+            logger.info(
+                "grammar-constrained decode on: profile=%s hash=%s "
+                "states=%d classes=%d",
+                self.grammar_profile,
+                self._grammar.health()["grammar_hash"],
+                self._grammar.health()["states"],
+                self._grammar.health()["classes"])
         if not self._use_pool:
             self._build_prefill_fns()
             self._init_prefix_cache()
@@ -847,7 +990,10 @@ class BatchedJaxEngine(JaxEngine):
                 self.top_k, self.top_p, vocab_size=cfg.vocab_size,
                 health_check=self.slot_health_check,
                 finalize=self._replicated,
-                pool_tables=self._use_pool)
+                pool_tables=self._use_pool,
+                grammar=self._grammar is not None,
+                grammar_s_max=(self._grammar.S_max
+                               if self._grammar is not None else 0))
 
         def batched_chunk_legacy(params, tok, pos, cache, seeds, temps,
                                  force, active, ngen, budget, corrupt,
@@ -918,8 +1064,14 @@ class BatchedJaxEngine(JaxEngine):
 
         # Keyed by KV bucket alone (one fixed chunk_len here) — distinct
         # from the parent's (chunk_len, kv_limit)-keyed self._chunk_fns.
+        # The grammar FSM-state vector is donated like the rest of the
+        # chained carry (its position depends on whether the pool table
+        # argument precedes it).
+        donate = (1, 2, 3, 7, 8)
+        if self._grammar is not None:
+            donate = donate + ((12,) if self._use_pool else (11,))
         self._batch_chunk_fns = {
-            b: jax.jit(chunk_body(b), donate_argnums=(1, 2, 3, 7, 8))
+            b: jax.jit(chunk_body(b), donate_argnums=donate)
             for b in self._kv_buckets
         }
 
@@ -1015,15 +1167,8 @@ class BatchedJaxEngine(JaxEngine):
             jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32),
         )
         for kv_b in self._kv_buckets:
-            (packed, self._tok_d, self._pos_d, self._cache,
-             self._active_d, self._ngen_d) = (
-                self._batch_chunk_fns[kv_b](
-                    self.params, self._tok_d, self._pos_d, self._cache,
-                    self._seeds_d, self._temps_d,
-                    jnp.zeros((N,), jnp.bool_),
-                    self._active_d, self._ngen_d, self._budget_d,
-                    self._no_corrupt_d)
-            )
+            packed = self._run_chunk(kv_b, jnp.zeros((N,), jnp.bool_),
+                                     self._no_corrupt_d)
         # Warm the batched-admission programs. Group scratch is allocated
         # at SUFFIX depth now — kv_limit positions (prefix + suffix bucket,
         # tile-rounded), not S_alloc: a suffix admission only ever fills
@@ -1165,6 +1310,11 @@ class BatchedJaxEngine(JaxEngine):
         # decode:nan fault seam — all-False in normal serving; a drill
         # dispatch swaps in a mask that NaNs the target slot's logits.
         self._no_corrupt_d = jnp.zeros((N,), jnp.bool_)
+        # Grammar FSM state words (ISSUE 11): global state 0 = profile
+        # 0's DEAD state — harmless for empty slots (never live) and
+        # re-armed by every admission/replay path.
+        if self._grammar is not None:
+            self._fsm_d = jnp.zeros((N,), jnp.int32)
         if self.mesh is not None:
             from ..parallel.sharding import shard_tokens
 
@@ -1176,6 +1326,8 @@ class BatchedJaxEngine(JaxEngine):
             self._budget_d = shard_tokens(self._budget_d, self.mesh)
             self._seeds_d = shard_tokens(self._seeds_d, self.mesh)
             self._no_corrupt_d = shard_tokens(self._no_corrupt_d, self.mesh)
+            if self._grammar is not None:
+                self._fsm_d = shard_tokens(self._fsm_d, self.mesh)
 
     # ------------------------------------- block-paged KV pool (ISSUE 10)
     #
@@ -1431,18 +1583,59 @@ class BatchedJaxEngine(JaxEngine):
         if len(ids) > max_prompt:
             ids = ids[-max_prompt:]
         n_prompt = len(ids)
+        # Grammar admission fast-forward (ISSUE 11): with no chunks in
+        # flight for a fresh slot, the forced chain from the START
+        # state ("kubectl " and onward) is pure profit — it rides the
+        # SAME prefill pass as the prompt, and the first sampled token
+        # moves to the post-run index of the seed stream (forced tokens
+        # consume indices, never randomness — byte-identical to masked
+        # step-by-step decode).
+        run: List[int] = []
+        ends_eos = False
+        gs1 = -1
+        if self._grammar is not None and req.gpid >= 0:
+            gs1 = self._grammar.start_state(req.gpid)
+            run, ends_eos, gs_end = self._grammar.forced_run(
+                gs1, req.max_tokens)
+            if len(run) >= self.grammar_forced_run_min or (
+                    ends_eos and run):
+                gs1 = gs_end
+            else:
+                run, ends_eos = [], False
+        full = ids + run
         blocks, m = self._pool_map_prefix(ids)
         try:
+            grow = pages_for(len(full), self.kv_pool_page) - len(blocks)
+            if grow > 0:
+                extra = self._pool_alloc(grow)
+                if extra is None:
+                    if run:          # pool pressure: decode the run
+                        run, ends_eos = [], False
+                        gs1 = (self._grammar.start_state(req.gpid)
+                               if gs1 >= 0 else -1)
+                        full = ids
+                    else:
+                        raise EngineUnavailable(
+                            "admission failed: kv pool exhausted")
+                else:
+                    blocks = blocks + extra
             self._tables[slot_idx, :] = self._pool_n_blocks
             self._tables[slot_idx, :len(blocks)] = blocks
+            done_at_admit = run and (len(run) >= req.max_tokens
+                                     or ends_eos)
+            span = full if not done_at_admit else full[:-1]
             last_logits = self._pool_prefill_span(
-                self._tables[slot_idx], ids, m)
-            first_key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
-            first_tok_d = self._sample_fn(
-                last_logits, first_key,
-                jnp.asarray(req.temperature, jnp.float32))
-            self._run_arm(slot_idx, n_prompt, first_tok_d,
-                          req.temperature, req.max_tokens, req.seed, 1)
+                self._tables[slot_idx], span, m)
+            first_tok_d = None
+            if not done_at_admit:
+                first_tok_d = self._grammar_first_sample(
+                    last_logits, req, gs1, len(run))
+                self._run_arm(slot_idx, n_prompt + len(run), first_tok_d,
+                              req.temperature, req.max_tokens, req.seed,
+                              1 + len(run))
+                if gs1 >= 0:
+                    self._grammar_arm_after_sample(slot_idx, gs1,
+                                                   first_tok_d)
         except Exception:
             self._tables[slot_idx, :] = self._pool_n_blocks
             self._pool.decref(blocks)
@@ -1451,14 +1644,15 @@ class BatchedJaxEngine(JaxEngine):
             req=req,
             detok=StreamDecoder(self.tokenizer),
             n_prompt=n_prompt,
-            pos=n_prompt,
+            pos=n_prompt + len(run),
             queue_ms=wait_ms,
             t_admit=t_adm,
             t_decode0=t_adm,
-            chunks_inflight=1,
+            chunks_inflight=0 if done_at_admit else 1,
             prefix_hit=m > 0,
             blocks=blocks,
             pool_ids=ids,
+            gs=gs1,
         )
         if req.export is not None:
             req.export.blocks = list(blocks)
@@ -1468,6 +1662,29 @@ class BatchedJaxEngine(JaxEngine):
                 f"tokens, {m} radix-matched, "
                 f"{pages_for(n_prompt, self.kv_pool_page)} pool blocks)")
         self._slots[slot_idx] = slot
+        if run:
+            t_dk = time.monotonic()
+            piece = slot.detok.push(*run)
+            slot.detok_ms += (time.monotonic() - t_dk) * 1000.0
+            if req.export is not None:
+                req.export.ids = list(slot.detok.ids)
+            if req.t_first0 is None:
+                req.t_first0 = time.monotonic()
+            if piece is not None:
+                self._emit(req, "token", piece)
+            self._grammar_forced += len(run)
+            self._grammar_ff_splices += 1
+            if req.trace is not None:
+                req.trace.event(
+                    f"grammar: admission forced run of {len(run)} tokens "
+                    f"spliced with the prompt prefill")
+        if done_at_admit:
+            slot.t_first = time.monotonic()
+            self._finish(slot_idx,
+                         "stop" if ends_eos
+                         and len(run) < req.max_tokens else "length")
+            self._last_admit_t = time.monotonic()
+            return
         self._to_host_async(first_tok_d)
         self._inflight.append(("first", first_tok_d, req, slot_idx))
         self._last_admit_t = time.monotonic()
@@ -1497,15 +1714,8 @@ class BatchedJaxEngine(JaxEngine):
         self._run_cow(blocks[0], blocks[0], 0)
         tables_d = jnp.asarray(self._tables)
         for kv_b in self._kv_buckets:
-            (packed, self._tok_d, self._pos_d, self._cache,
-             self._active_d, self._ngen_d) = (
-                self._batch_chunk_fns[kv_b](
-                    self.params, self._tok_d, self._pos_d, self._cache,
-                    self._seeds_d, self._temps_d,
-                    jnp.zeros((N,), jnp.bool_),
-                    self._active_d, self._ngen_d, self._budget_d,
-                    self._no_corrupt_d, tables_d)
-            )
+            packed = self._run_chunk(kv_b, jnp.zeros((N,), jnp.bool_),
+                                     self._no_corrupt_d, tables_d)
         packed.block_until_ready()
         self._pool.decref(blocks)
         self._pool_preload_system_prompt()
@@ -1564,6 +1774,222 @@ class BatchedJaxEngine(JaxEngine):
         body["starved_slots_total"] = self._pool_starved
         body["radix"] = (self._radix.stats() if self._radix is not None
                          else None)
+        return body
+
+    # ------------------------------- grammar-constrained decode (ISSUE 11)
+    #
+    # Host truth: the GrammarRuntime's numpy tables + each slot's ``gs``
+    # field (the FSM state over CONSUMED tokens). The device carries its
+    # own speculative state vector (_fsm_d) exactly like ngen/active;
+    # every admission/replay path re-arms it from host truth.
+
+    def _grammar_tables_d(self) -> tuple:
+        """Device copies of the stacked grammar tables, refreshed when a
+        per-request variant install bumped the runtime's version (table
+        shapes are fixed, so this never re-traces the chunk program).
+        The refresh reads a lock-consistent snapshot and stamps ITS
+        version — a racing install can neither tear the copied rows nor
+        leave a post-install version on pre-install contents."""
+        g = self._grammar
+        if g.version != self._grammar_version:
+            version, tc, ok, nxt = g.snapshot_tables()
+            self._gram_tc_d = jnp.asarray(tc)
+            self._gram_ok_d = jnp.asarray(ok)
+            self._gram_next_d = jnp.asarray(nxt)
+            self._grammar_version = version
+        return self._gram_tc_d, self._gram_ok_d, self._gram_next_d
+
+    @property
+    def _grammar_set_fn(self):
+        """Jitted single-slot FSM-state write (the grammar analog of the
+        arm program's per-slot scatter)."""
+        fn = getattr(self, "_grammar_set_jit", None)
+        if fn is None:
+            def set_state(fsm, slot, gs):
+                return fsm.at[slot].set(gs)
+
+            fn = jax.jit(set_state, donate_argnums=(0,))
+            self._grammar_set_jit = fn
+        return fn
+
+    def _grammar_arm(self, slot_idx: int, gs: int) -> None:
+        self._fsm_d = self._grammar_set_fn(
+            self._fsm_d, jnp.asarray(slot_idx, jnp.int32),
+            jnp.asarray(gs, jnp.int32))
+
+    @property
+    def _grammar_arm_sampled_fn(self):
+        """Jitted FSM arm for an admission whose first token is still a
+        device value (zero host reads — the admission contract): the
+        slot's device state becomes advance(gs_base, first_tok),
+        computed through the stacked tables on device."""
+        fn = getattr(self, "_grammar_arm_sampled_jit", None)
+        if fn is None:
+            s_max = self._grammar.S_max
+
+            def arm(fsm, tc, nxt_tbl, slot, gs_base, first_tok):
+                cls = tc[gs_base // s_max, first_tok[0]]
+                return fsm.at[slot].set(nxt_tbl[gs_base, cls])
+
+            fn = jax.jit(arm, donate_argnums=(0,))
+            self._grammar_arm_sampled_jit = fn
+        return fn
+
+    def _grammar_arm_after_sample(self, slot_idx: int, gs_base: int,
+                                  first_tok_d) -> None:
+        tc, _, nx = self._grammar_tables_d()
+        self._fsm_d = self._grammar_arm_sampled_fn(
+            self._fsm_d, tc, nx, jnp.asarray(slot_idx, jnp.int32),
+            jnp.asarray(gs_base, jnp.int32), first_tok_d)
+
+    def _grammar_first_sample(self, last_logits, req: "_Request",
+                              gs: int, gen_index: int):
+        """Masked admission first-token sample at generation index
+        ``gen_index`` of the request's seed stream (index 0 for a plain
+        admission; the post-run index after an admission fast-forward —
+        forced tokens consume indices but no randomness)."""
+        key = jax.random.fold_in(jax.random.PRNGKey(req.seed), gen_index)
+        temp = jnp.asarray(req.temperature, jnp.float32)
+        if self._grammar is None or req.gpid < 0:
+            return self._sample_fn(last_logits, key, temp)
+        mask_d = jnp.asarray(self._grammar.allowed_np(gs))
+        return self._grammar_mask_sample_fn(last_logits, key, temp,
+                                            mask_d)
+
+    @property
+    def _grammar_mask_sample_fn(self):
+        """Jitted masked single-logits sampler for admission first
+        tokens: drop illegal logits to -inf, then the same seeded
+        sampler the unmasked path runs (same key stream, renormalized
+        over the masked support)."""
+        fn = getattr(self, "_grammar_mask_sample_jit", None)
+        if fn is None:
+            def masked(logits, key, temperature, mask):
+                return self._sample_fn(
+                    jnp.where(mask, logits, -jnp.inf), key, temperature)
+
+            fn = jax.jit(masked)
+            self._grammar_mask_sample_jit = fn
+        return fn
+
+    def _grammar_note_dead_end(self, cause: str) -> None:
+        self._grammar_dead_ends[cause] = \
+            self._grammar_dead_ends.get(cause, 0) + 1
+
+    def _grammar_consume(self, slot: "_Slot", new_ids) -> None:
+        """Advance a slot's host FSM state by consumed tokens and count
+        them as masked decode steps."""
+        for t in new_ids:
+            slot.gs = self._grammar.advance(slot.gs, int(t))
+        self._grammar_masked += len(new_ids)
+
+    def _grammar_fast_forward(self, idx: int, slot: "_Slot") -> None:
+        """Forced-run fast-forward (the ISSUE 11 tentpole): when the
+        slot's FSM state starts a single-successor chain, splice the
+        whole run as ONE suffix prefill into its pool blocks instead of
+        decoding it token-by-token.
+
+        Net-win policy: in-flight speculative chunks would decode the
+        run's prefix anyway (their compute is sunk and, under masking,
+        their tokens are exactly the forced tokens), so the splice only
+        fires when the chain exceeds what the pipe already covers by
+        GRAMMAR_FORCED_RUN_MIN. The spliced-over in-flight chunks are
+        marked stale (consumed rows skipped — their token indexing is
+        pre-splice) and billed as masked waste, mirroring preemption.
+
+        RNG discipline: forced tokens consume generation indices but no
+        randomness; the next sampled token draws fold_in(seed, ngen) at
+        the post-run index — byte-identical to what masked step-by-step
+        decode (singleton support forces the same tokens) would have
+        produced, which is the fast-forward on/off parity the tests
+        pin."""
+        if (self._grammar is None or not self._use_pool
+                or slot.req.gpid < 0 or slot.exhausted):
+            return
+        req = slot.req
+        g = len(slot.detok.ids)
+        cap = req.max_tokens - g
+        if cap <= 0:
+            return
+        run, ends_eos, end_gs = self._grammar.forced_run(slot.gs, cap)
+        covered = slot.decode_chunks_inflight * self.chunk_len
+        net = len(run) - covered
+        if net < self.grammar_forced_run_min and not (
+                ends_eos and run and net > 0):
+            return
+        n_prompt = len(slot.pool_ids or [])
+        base = n_prompt + g          # absolute position after current ids
+        if base + len(run) > self._S_alloc:
+            return                   # capacity end is the sweep's job
+        # Grow the block table to cover the run's KV rows.
+        need = pages_for(base + len(run), self.kv_pool_page)
+        while len(slot.blocks) < need:
+            b = self._pool_alloc(1)
+            if b is None:
+                return               # pool pressure: decode normally
+            self._tables[idx, len(slot.blocks)] = b[0]
+            slot.blocks.extend(b)
+        # One forward derives the run's KV: positions base-1..base+f-2,
+        # i.e. the last already-emitted token (whose row decode had not
+        # written yet) plus run[:-1]; the run's last token becomes the
+        # device carry and is written by the next decode step, keeping
+        # the "last generated token's KV row is unwritten" invariant
+        # every replay/radix path assumes.
+        ids_full = list(slot.pool_ids or []) + list(slot.detok.ids) + run
+        self._pool_prefill_span(self._tables[idx],
+                                ids_full[:base + len(run) - 1],
+                                max(0, base - 1))
+        t_dk = time.monotonic()
+        piece = slot.detok.push(*run)
+        slot.detok_ms += (time.monotonic() - t_dk) * 1000.0
+        slot.gs = end_gs
+        if req.export is not None:
+            req.export.ids = list(slot.detok.ids)
+            req.export.blocks = list(slot.blocks)
+        if piece is not None:
+            self._emit(req, "token", piece)
+        self._grammar_forced += len(run)
+        self._grammar_ff_splices += 1
+        # Stale in-flight chunks: their rows index a pre-splice token
+        # stream — skip them at consume (FIFO makes the count exact)
+        # and own up to their now-redundant device steps.
+        if slot.decode_chunks_inflight > 0:
+            self._bill_waste(min(covered, cap), req)
+            slot.stale_chunks += slot.decode_chunks_inflight
+        if req.trace is not None:
+            req.trace.event(
+                f"grammar: forced run of {len(run)} tokens spliced as "
+                f"one prefill (state {slot.gs}, "
+                f"{'EOS next' if ends_eos else 'decode resumes'})")
+        new_g = len(slot.detok.ids)
+        if new_g >= req.max_tokens:
+            slot.pos = max(slot.pos, base + len(run))
+            self._finish(idx, "length")
+            return
+        if ends_eos:
+            slot.pos = max(slot.pos, base + len(run))
+            self._finish(idx, "stop")
+            return
+        # Re-arm the device: carry = the run's last token at its own
+        # position; ngen = new_g re-aligns the per-request RNG stream
+        # (fold_in(seed, generation_index) — sampling resumes at the
+        # index unconstrained masked decode would have reached).
+        self._run_arm(idx, base + len(run) - 1,
+                      jnp.asarray([run[-1]], jnp.int32),
+                      req.temperature, req.max_tokens, req.seed, new_g)
+        self._grammar_arm(idx, end_gs)
+        slot.pos = max(slot.pos, base + len(run))
+
+    def grammar_health(self) -> Optional[dict]:
+        """Cheap grammar view for /health (host counters only — same
+        rule as qos_health/kv_pool_health)."""
+        if self._grammar is None:
+            return None
+        body = dict(self._grammar.health())
+        body["forced_tokens_total"] = self._grammar_forced
+        body["masked_steps_total"] = self._grammar_masked
+        body["fast_forward_splices_total"] = self._grammar_ff_splices
+        body["dead_ends_total"] = dict(self._grammar_dead_ends)
         return body
 
     def _warm_batch_admit_shapes(self) -> None:
@@ -1825,6 +2251,11 @@ class BatchedJaxEngine(JaxEngine):
             # time (Metrics.observe_ledger / observe_slo). Pure reads.
             "ledger": self.ledger.snapshot(),
             "slo": self._slo.snapshot(),
+            # Grammar-constrained decoding (ISSUE 11): forced/masked
+            # token totals + dead ends by cause — delta-mirrored at
+            # scrape time (Metrics.observe_grammar) and summarized in
+            # /health's grammar section.
+            "grammar": self.grammar_health(),
         }
 
     #: finish timestamps older than this don't feed the drain-rate
@@ -2307,6 +2738,13 @@ class BatchedJaxEngine(JaxEngine):
         slot.pos = n_total
         slot.chunks_inflight = 0
         slot.decode_chunks_inflight = 0
+        slot.stale_chunks = 0
+        if self._grammar is not None and req.gpid >= 0:
+            # Host truth and device state both re-derive from the
+            # emitted ids: the next masked step samples at the state the
+            # fault-free run would be in.
+            slot.gs = self._grammar.run(req.gpid, ids)
+            self._grammar_arm(slot_idx, slot.gs)
         slot.exhausted = n_total >= self.max_seq_len
         self._slots[slot_idx] = slot
         self.supervisor.note_replay(g)
@@ -2808,6 +3246,11 @@ class BatchedJaxEngine(JaxEngine):
             # path (their KV is prompt + generated prefix, not a
             # prefix-cache suffix shape).
             return None
+        if self._grammar is not None and req.gpid >= 0:
+            # Grammar requests sample their first token MASKED (and may
+            # admission-fast-forward); the group program samples
+            # unmasked — route them through the single path.
+            return None
         ids = req.prompt_ids
         max_prompt = self.max_seq_len - max(1, req.max_tokens)
         if len(ids) > max_prompt or not self._prefix.matches(ids):
@@ -3064,10 +3507,12 @@ class BatchedJaxEngine(JaxEngine):
         # stream (same key derivation as the in-chunk sampler), so a
         # containment replay — or an offline reproduction from the seed
         # in /debug/requests/{id} — regenerates it bit-identically.
-        first_key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
-        first_tok_d = self._sample_fn(
-            last_logits, first_key, jnp.asarray(req.temperature, jnp.float32)
-        )
+        # Under GRAMMAR_DECODE the sample is masked to the START state's
+        # legal set (dense mode: masking only — fast-forward needs the
+        # pool's suffix-prefill path).
+        gs0 = (self._grammar.start_state(req.gpid)
+               if self._grammar is not None and req.gpid >= 0 else -1)
+        first_tok_d = self._grammar_first_sample(last_logits, req, gs0, 0)
         (self._cache, self._tok_d, self._pos_d, self._temps_d,
          self._active_d, self._ngen_d, self._budget_d,
          self._seeds_d) = self._splice_fn(
@@ -3081,6 +3526,8 @@ class BatchedJaxEngine(JaxEngine):
             jnp.asarray(req.seed, jnp.int32), jnp.asarray(1, jnp.int32),
         )
 
+        if gs0 >= 0:
+            self._grammar_arm_after_sample(slot_idx, gs0, first_tok_d)
         slot = _Slot(
             req=req,
             detok=StreamDecoder(self.tokenizer),
@@ -3091,6 +3538,7 @@ class BatchedJaxEngine(JaxEngine):
             t_decode0=t_adm,
             chunks_inflight=1,
             prefix_hit=prefix_hit,
+            gs=gs0,
         )
         if req.trace is not None:
             req.trace.event(
@@ -3191,8 +3639,13 @@ class BatchedJaxEngine(JaxEngine):
             req.export.ids = list(slot.detok.ids)
         if piece is not None:
             self._emit(req, "token", piece)
+        if self._grammar is not None and req.gpid >= 0:
+            self._grammar_consume(slot, [first_tok])
         if req.max_tokens <= 1:
             self._finish(slot_idx, "length")
+            return
+        if self._grammar is not None and req.gpid >= 0:
+            self._grammar_fast_forward(slot_idx, slot)
 
     def _sweep_finishes(self) -> None:
         """Host-only finishes before a dispatch: cancellation, deadline,
@@ -3217,6 +3670,30 @@ class BatchedJaxEngine(JaxEngine):
                 slot.exhausted = True
                 if slot.chunks_inflight == 0:
                     self._finish(i, "length")
+
+    def _run_chunk(self, bucket: int, force_d, corrupt_d,
+                   tables_d=None):
+        """Invoke one decode-chunk program with the mode-correct
+        argument tail (pool block tables, grammar state + tables) and
+        thread the chained device state back — the single call site the
+        warmups and the dispatcher share, so an argument-shape drift
+        between modes is structurally impossible."""
+        args = (self.params, self._tok_d, self._pos_d, self._cache,
+                self._seeds_d, self._temps_d, force_d, self._active_d,
+                self._ngen_d, self._budget_d, corrupt_d)
+        if tables_d is not None:
+            args = args + (tables_d,)
+        if self._grammar is not None:
+            tc, ok, nx = self._grammar_tables_d()
+            args = args + (self._fsm_d, tc, ok, nx)
+        out = self._batch_chunk_fns[bucket](*args)
+        if self._grammar is not None:
+            (packed, self._tok_d, self._pos_d, self._cache,
+             self._active_d, self._ngen_d, self._fsm_d) = out
+        else:
+            (packed, self._tok_d, self._pos_d, self._cache,
+             self._active_d, self._ngen_d) = out
+        return packed
 
     def _dispatch_chunk(self) -> None:
         if self.faults is not None:
@@ -3277,15 +3754,9 @@ class BatchedJaxEngine(JaxEngine):
                     # the one production serving exercises.
                     from ..parallel.sharding import shard_tokens
                     corrupt_d = shard_tokens(corrupt_d, self.mesh)
-        chunk_args = (self.params, self._tok_d, self._pos_d, self._cache,
-                      self._seeds_d, self._temps_d, force, self._active_d,
-                      self._ngen_d, self._budget_d, corrupt_d)
-        if self._use_pool:
-            chunk_args = chunk_args + (jnp.asarray(self._tables),)
-        (packed_d, self._tok_d, self._pos_d, self._cache,
-         self._active_d, self._ngen_d) = (
-            self._batch_chunk_fns[bucket](*chunk_args)
-        )
+        packed_d = self._run_chunk(
+            bucket, force, corrupt_d,
+            jnp.asarray(self._tables) if self._use_pool else None)
         snapshot = [
             s.req if s is not None and not s.exhausted else None
             for s in self._slots
@@ -3464,6 +3935,11 @@ class BatchedJaxEngine(JaxEngine):
                     "t": time.time(), "event": "health_trip", "slot": i,
                     "health": describe_health(int(res.health[i])),
                 })
+                if int(res.health[i]) & HEALTH_GRAMMAR_DEAD:
+                    # Grammar dead end (ISSUE 11): the FSM state admits
+                    # no legal token — the slot froze before emitting
+                    # anything and rides the normal quarantine lane.
+                    self._grammar_note_dead_end("decode")
                 slot = self._slots[i]
                 if slot.req.trace is not None:
                     slot.req.trace.event(
@@ -3486,6 +3962,13 @@ class BatchedJaxEngine(JaxEngine):
                 continue
             slot.chunks_inflight -= 1
             slot.decode_chunks_inflight -= 1
+            if slot.stale_chunks > 0:
+                # A forced-run fast-forward spliced over this chunk:
+                # its rows index the pre-splice stream (consume FIFO
+                # order makes the countdown exact). Nothing to emit —
+                # the splice already delivered these tokens.
+                slot.stale_chunks -= 1
+                continue
             if self.device_termination:
                 new_ids, finish = consume_chunk_row(
                     res.tokens[i], bool(res.done[i]), int(res.lengths[i]),
@@ -3510,6 +3993,12 @@ class BatchedJaxEngine(JaxEngine):
                     slot.req.export.ids = list(slot.detok.ids)
                 if piece is not None:
                     self._emit(slot.req, "token", piece)
+                if self._grammar is not None and slot.req.gpid >= 0:
+                    self._grammar_consume(slot, new_ids)
+                    if finish is None:
+                        self._grammar_fast_forward(i, slot)
+                        if self._slots[i] is not slot:
+                            continue   # fast-forward finished the slot
             if slot.req.trace is not None:
                 slot.req.trace.event(
                     f"engine: chunk consumed (+{len(new_ids)} tok"
@@ -3706,6 +4195,26 @@ class BatchedJaxEngine(JaxEngine):
         lane = (qctx.lane if qctx is not None
                 and qctx.lane in LANES else LANE_INTERACTIVE)
         trace = current_trace()
+        # Grammar resolution (ISSUE 11): base profile, clamped readonly
+        # for the background tier (TENANT_TIERS floor) or an explicit
+        # readonly ask, narrowed by a validated allowed-verbs set —
+        # resolved HERE so the scheduler only ever sees a profile id.
+        gpid = -1
+        if self._grammar is not None:
+            from ..constrain import current_grammar
+
+            gctx = current_grammar()
+            if gctx is not None and gctx.allowed_verbs:
+                # A novel allowed-verbs set compiles a variant FSM —
+                # seconds of CPU at a real vocab — so it runs off the
+                # event loop (cached sets return instantly there too).
+                gpid = await asyncio.to_thread(
+                    self._grammar.resolve, lane=lane, ctx=gctx)
+            else:
+                gpid = self._grammar.resolve(lane=lane, ctx=gctx)
+            if trace is not None:
+                trace.event(f"grammar: profile id {gpid} "
+                            f"(lane={lane})")
         loop = asyncio.get_running_loop()
         if self.faults is not None and not getattr(self, "_warming", False):
             # tenant:flood:<n> drill — a synthetic background-tenant
@@ -3743,6 +4252,7 @@ class BatchedJaxEngine(JaxEngine):
             # and the client's first byte happened there too.
             ledger_delivered=len(resume_ids) if resume_ids else 0,
             ttft_exempt=bool(resume_ids),
+            gpid=gpid,
         )
         # Fair-share load shedding at submit time (QoSQueue policy):
         # past the per-tenant cap → 429 to the flooding tenant; past

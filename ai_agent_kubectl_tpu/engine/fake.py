@@ -38,8 +38,9 @@ from .fallback import extract_query, rule_command  # rules promoted there
 from .kv_pool import (BlockPool, PoolExhausted, alloc_with_evict,
                       map_prefix, pages_for)
 from .radix_cache import RadixCache
-from .protocol import (HEALTH_NONFINITE, EngineOverloaded, EngineResult,
-                       EngineUnavailable, GenerationTimeout, RequestExport,
+from .protocol import (HEALTH_GRAMMAR_DEAD, HEALTH_NONFINITE,
+                       EngineOverloaded, EngineResult, EngineUnavailable,
+                       GenerationTimeout, RequestExport,
                        RequestQuarantined, consume_chunk_row, pack_chunk,
                        scan_chunk_row, unpack_chunk)
 from .qos import (ANON_TENANT, LANE_BACKGROUND, LANE_BATCH, LANE_INTERACTIVE,
@@ -176,6 +177,9 @@ class _FakeReq:
     # so a re-sent multi-turn history radix-matches exactly like real
     # tokenization does.
     prompt_ids: List[int] = dataclasses.field(default_factory=list)
+    # Grammar-constrained decoding mirror (ISSUE 11): the resolved
+    # grammar profile id (-1 = unconstrained).
+    gpid: int = -1
 
 
 @dataclasses.dataclass
@@ -194,6 +198,13 @@ class _FakeSlot:
     blocks: List[int] = dataclasses.field(default_factory=list)
     pool_ids: List[int] = dataclasses.field(default_factory=list)
     pool_starved: bool = False
+    # Grammar mirror (ISSUE 11): ``gs`` = host-truth FSM state over the
+    # CONSUMED stream, ``dev_gs`` = the device twin's speculative state
+    # (advanced at dispatch, exactly like dev_idx/dev_ngen), and the
+    # count of in-flight chunks a forced-run splice superseded.
+    gs: int = 0
+    dev_gs: int = 0
+    stale_chunks: int = 0
 
 
 class FakeChunkedEngine:
@@ -232,6 +243,9 @@ class FakeChunkedEngine:
                  kv_pool_blocks: int = 0,
                  radix_cache: bool = True,
                  radix_lru_blocks: int = 0,
+                 grammar_decode: bool = False,
+                 grammar_profile: str = "default",
+                 grammar_forced_run_min: int = 4,
                  max_seq_len: int = 256,
                  faults=None,
                  stream_fn: Optional[Callable[[str], List[int]]] = None):
@@ -312,6 +326,31 @@ class FakeChunkedEngine:
         self._pool_starved = 0
         if self.kv_pool:
             self._pool_reset()
+        # Grammar-constrained decoding mirror (ISSUE 11): the SAME
+        # GrammarRuntime/TokenFSM compile the batcher runs, built
+        # against the ByteTokenizer the fake's grammar streams use
+        # (token ids 3..258 = UTF-8 bytes), stepped host-side per
+        # scripted token — the tier-1 home of the grammar invariants
+        # (never an off-grammar token, dead ends trip the health lane,
+        # forced splices keep the pool books balanced).
+        if grammar_decode and not device_termination:
+            raise ValueError("GRAMMAR_DECODE requires DEVICE_TERMINATION")
+        self.grammar_decode = bool(grammar_decode)
+        self.grammar_forced_run_min = max(1, grammar_forced_run_min)
+        self._grammar = None
+        if self.grammar_decode:
+            from ..constrain import GrammarRuntime
+            from .tokenizer import ByteTokenizer
+
+            tok = ByteTokenizer()
+            self._grammar = GrammarRuntime(
+                tok, tok.vocab_size, self.eos_ids,
+                profile=grammar_profile,
+                forced_run_min=self.grammar_forced_run_min)
+        self._grammar_forced = 0
+        self._grammar_masked = 0
+        self._grammar_dead_ends: Dict[str, int] = {}
+        self._grammar_ff_splices = 0
 
     # ------------------------------------- block-paged KV pool (mirror)
 
@@ -422,6 +461,93 @@ class FakeChunkedEngine:
                          else None)
         return body
 
+    # ------------------------------- grammar-constrained decode (ISSUE 11)
+
+    def _grammar_pick(self, gs: int, raw: int) -> Optional[int]:
+        """The fake's 'renormalized draw': the scripted token when it is
+        grammar-legal from ``gs``, else the deterministic fallback —
+        lowest legal non-EOS token (EOS only when it is the sole legal
+        move). None = dead end (no legal token at all); the caller
+        freezes the slot on HEALTH_GRAMMAR_DEAD exactly like the jitted
+        scan."""
+        allowed = self._grammar.allowed_np(gs)
+        if 0 <= raw < allowed.shape[0] and allowed[raw]:
+            return raw
+        legal = np.nonzero(allowed)[0]
+        if legal.size == 0:
+            return None
+        non_eos = [int(t) for t in legal if int(t) not in self.eos_ids]
+        return non_eos[0] if non_eos else int(legal[0])
+
+    def _grammar_note_dead_end(self, cause: str) -> None:
+        self._grammar_dead_ends[cause] = \
+            self._grammar_dead_ends.get(cause, 0) + 1
+
+    def _grammar_consume(self, slot: _FakeSlot, new_ids) -> None:
+        for t in new_ids:
+            slot.gs = self._grammar.advance(slot.gs, int(t))
+        self._grammar_masked += len(new_ids)
+
+    def _grammar_fast_forward(self, idx: int, slot: _FakeSlot) -> None:
+        """Forced-run fast-forward, numpy twin of the batcher's: splice
+        the single-successor chain in one step, mark the superseded
+        in-flight chunks stale, re-derive the device cursors at the
+        post-run indices (the scripted stream's entries for those
+        indices were going to be coerced to exactly these tokens — the
+        same singleton-support argument that makes the real splice
+        byte-identical to masked step-by-step decode)."""
+        if (self._grammar is None or slot.req.gpid < 0
+                or slot.pool_starved):
+            return
+        req = slot.req
+        g = len(slot.emitted)
+        cap = req.max_tokens - g
+        if cap <= 0:
+            return
+        run, ends_eos, end_gs = self._grammar.forced_run(slot.gs, cap)
+        covered = slot.decode_chunks_inflight * self.chunk_len
+        net = len(run) - covered
+        if net < self.grammar_forced_run_min and not (
+                ends_eos and run and net > 0):
+            return
+        slot.emitted.extend(run)
+        slot.gs = end_gs
+        slot.dev_gs = end_gs
+        slot.dev_idx = len(slot.emitted)
+        slot.dev_ngen = len(slot.emitted)
+        slot.last_tok = run[-1]
+        if req.export is not None:
+            req.export.ids = list(slot.emitted)
+        self._grammar_forced += len(run)
+        self._grammar_ff_splices += 1
+        if slot.decode_chunks_inflight > 0:
+            self._bill_waste(min(covered, cap), req)
+            slot.stale_chunks += slot.decode_chunks_inflight
+        if self._pool is not None:
+            self._pool_ensure_coverage(slot)
+        req.out_queue.put_nowait(
+            ("token", self._piece(run, g)))
+        if req.trace is not None:
+            req.trace.event(
+                f"grammar: forced run of {len(run)} tokens spliced")
+        if len(slot.emitted) >= req.max_tokens:
+            self._finish(idx, "length")
+            return
+        if ends_eos:
+            self._finish(idx, "stop")
+            return
+        slot.dev_active = True
+
+    def grammar_health(self) -> Optional[dict]:
+        if self._grammar is None:
+            return None
+        body = dict(self._grammar.health())
+        body["forced_tokens_total"] = self._grammar_forced
+        body["masked_steps_total"] = self._grammar_masked
+        body["fast_forward_splices_total"] = self._grammar_ff_splices
+        body["dead_ends_total"] = dict(self._grammar_dead_ends)
+        return body
+
     # ----------------------------------------------------------- streams
 
     def _default_stream(self, prompt: str) -> List[int]:
@@ -520,6 +646,7 @@ class FakeChunkedEngine:
             "kv_pool": self.kv_pool_health(),
             "ledger": self.ledger.snapshot(),
             "slo": self._slo.snapshot(),
+            "grammar": self.grammar_health(),
         }
 
     # ------------------------------------------ telemetry plane (ISSUE 8)
@@ -819,6 +946,11 @@ class FakeChunkedEngine:
                     req.out_queue.put_nowait(("error", EngineUnavailable(
                         "admission failed: kv pool exhausted")))
                     continue
+                gs_r = 0
+                if self._grammar is not None and req.gpid >= 0:
+                    # Re-derive the FSM state from the imported prefix
+                    # (mirror of the batcher's replay re-arm).
+                    gs_r = self._grammar.run(req.gpid, req.resume_ids)
                 slot = _FakeSlot(
                     req=req, emitted=list(req.resume_ids), dev_idx=g,
                     dev_ngen=g,
@@ -826,7 +958,8 @@ class FakeChunkedEngine:
                                 if self.device_termination else True),
                     last_tok=req.resume_ids[-1],
                     t_first=time.monotonic(),
-                    blocks=blocks, pool_ids=basis)
+                    blocks=blocks, pool_ids=basis,
+                    gs=gs_r, dev_gs=gs_r)
                 if req.export is not None and blocks:
                     req.export.blocks = list(blocks)
                 if not req.resume_emitted:
@@ -855,22 +988,60 @@ class FakeChunkedEngine:
             # Admission "prefill": the stream's first token is emitted
             # immediately (the batcher pipelines it as a "first" entry;
             # collapsing that here keeps the fake synchronous without
-            # changing chunk semantics).
-            first = req.stream[0]
-            if first in self.eos_ids:
-                req.out_queue.put_nowait(("done", self._result(req, [], "stop")))
-                continue
+            # changing chunk semantics). Grammar mirror: the first
+            # token is the masked pick from the START state — or, when
+            # the START state's forced chain clears the net-win bar
+            # (it always does on a fresh slot: nothing is in flight),
+            # the whole run splices at admission exactly like the
+            # batcher rides it on the prompt prefill.
+            grammar_on = self._grammar is not None and req.gpid >= 0
+            run: List[int] = []
+            ends_eos = False
+            gs0 = -1
+            if grammar_on:
+                gs0 = self._grammar.start_state(req.gpid)
+                run, ends_eos, gs_end = self._grammar.forced_run(
+                    gs0, req.max_tokens)
+                if len(run) >= self.grammar_forced_run_min or (
+                        ends_eos and run):
+                    gs0 = gs_end
+                else:
+                    run, ends_eos = [], False
+            if run:
+                emitted0 = list(run)
+            else:
+                first = req.stream[0]
+                if grammar_on:
+                    picked = self._grammar_pick(gs0, first)
+                    if picked is None:   # structurally unreachable
+                        self._grammar_note_dead_end("admission")
+                        req.out_queue.put_nowait(
+                            ("error", EngineUnavailable(
+                                "grammar dead end at admission")))
+                        continue
+                    first = picked
+                if first in self.eos_ids:
+                    req.out_queue.put_nowait(
+                        ("done", self._result(req, [], "stop")))
+                    continue
+                emitted0 = [first]
+                if grammar_on:
+                    gs0 = self._grammar.advance(gs0, first)
+                    self._grammar_masked += 1
             try:
                 blocks, basis = self._pool_seat(req, 0)
             except PoolExhausted:
                 req.out_queue.put_nowait(("error", EngineUnavailable(
                     "admission failed: kv pool exhausted")))
                 continue
-            slot = _FakeSlot(req=req, emitted=[first], dev_idx=1,
-                             dev_ngen=1, dev_active=req.max_tokens > 1,
-                             last_tok=first,
+            slot = _FakeSlot(req=req, emitted=emitted0,
+                             dev_idx=len(emitted0),
+                             dev_ngen=len(emitted0),
+                             dev_active=req.max_tokens > len(emitted0),
+                             last_tok=emitted0[-1],
                              t_first=time.monotonic(),
-                             blocks=blocks, pool_ids=basis)
+                             blocks=blocks, pool_ids=basis,
+                             gs=gs0, dev_gs=gs0)
             if req.export is not None and blocks:
                 req.export.blocks = list(blocks)
             if req.t_first0 is None:
@@ -880,9 +1051,17 @@ class FakeChunkedEngine:
             self._slots[i] = slot
             if req.export is not None:
                 req.export.ids = list(slot.emitted)
-            req.out_queue.put_nowait(("token", self._piece([first], 0)))
-            if req.max_tokens <= 1:
+            req.out_queue.put_nowait(
+                ("token", self._piece(emitted0, 0)))
+            if run:
+                self._grammar_forced += len(run)
+                self._grammar_ff_splices += 1
+                if self._pool is not None:
+                    self._pool_ensure_coverage(slot)
+            if len(slot.emitted) >= req.max_tokens:
                 self._finish(i, "length")
+            elif run and ends_eos:
+                self._finish(i, "stop")
 
     def _dispatch_chunk(self) -> None:
         """The 'device': advance every live slot's stream cursor by up to
@@ -927,17 +1106,37 @@ class FakeChunkedEngine:
                     slot.dev_active = False
                     lengths[i] = slot.dev_ngen
                     continue
+            grammar_on = (self._grammar is not None
+                          and slot.req.gpid >= 0)
             for step in range(C):
                 if self.device_termination:
                     if not live:
                         toks[i, step] = slot.last_tok
                         continue
                     nxt = self._stream_at(slot.req.stream, slot.dev_idx)
+                    if grammar_on:
+                        # Grammar mirror: the scripted token passes
+                        # only if legal from the device FSM state; an
+                        # illegal one renormalizes to the deterministic
+                        # fallback; NO legal token = dead end — freeze
+                        # on the grammar health bit exactly like the
+                        # jitted scan (nothing from this state is ever
+                        # emitted).
+                        picked = self._grammar_pick(slot.dev_gs, nxt)
+                        if picked is None:
+                            health[i] |= HEALTH_GRAMMAR_DEAD
+                            toks[i, step:] = slot.last_tok
+                            live = False
+                            break
+                        nxt = picked
                     toks[i, step] = nxt
                     slot.last_tok = nxt
                     if nxt in self.eos_ids:
                         live = False
                         continue
+                    if grammar_on:
+                        slot.dev_gs = self._grammar.advance(
+                            slot.dev_gs, nxt)
                     slot.dev_idx += 1
                     slot.dev_ngen += 1
                     if slot.dev_ngen >= slot.req.max_tokens:
@@ -1001,6 +1200,9 @@ class FakeChunkedEngine:
         ]
         if tripped:
             self.supervisor.note_health_trips(len(tripped))
+            for i in tripped:
+                if int(res.health[i]) & HEALTH_GRAMMAR_DEAD:
+                    self._grammar_note_dead_end("decode")
             self._contain_poisoned_step(
                 CAUSE_SLOT_HEALTH,
                 named=[self._slots[i] for i in tripped])
@@ -1011,6 +1213,12 @@ class FakeChunkedEngine:
                     self._bill_waste(self.chunk_len, snapshot[i])
                 continue
             slot.decode_chunks_inflight -= 1
+            if slot.stale_chunks > 0:
+                # Superseded by a forced-run fast-forward (its rows
+                # index the pre-splice stream; FIFO consume keeps the
+                # countdown exact — mirror of the batcher).
+                slot.stale_chunks -= 1
+                continue
             if self.device_termination:
                 new_ids, finish = consume_chunk_row(
                     res.tokens[i], bool(res.done[i]), int(res.lengths[i]),
@@ -1026,6 +1234,12 @@ class FakeChunkedEngine:
                 if slot.req.export is not None:
                     slot.req.export.ids = list(slot.emitted)
                 slot.req.out_queue.put_nowait(("token", piece))
+                if self._grammar is not None and slot.req.gpid >= 0:
+                    self._grammar_consume(slot, new_ids)
+                    if finish is None:
+                        self._grammar_fast_forward(i, slot)
+                        if self._slots[i] is not slot:
+                            continue
             if finish is not None:
                 self._finish(i, finish)
         # Early exoneration (mirror of the batcher): after
@@ -1206,6 +1420,10 @@ class FakeChunkedEngine:
         slot.dev_active = (g < req.max_tokens
                            if self.device_termination else True)
         slot.decode_chunks_inflight = 0
+        slot.stale_chunks = 0
+        if self._grammar is not None and req.gpid >= 0:
+            slot.gs = self._grammar.run(req.gpid, slot.emitted)
+            slot.dev_gs = slot.gs
         self._slots[i] = slot
         self.supervisor.note_replay(g)
         # Ledger: the containment replay re-derives the emitted prefix
@@ -1260,17 +1478,23 @@ class FakeChunkedEngine:
 
     # ------------------------------------------------------------ serving
 
-    @staticmethod
-    def _piece(ids: List[int], offset: int) -> str:
-        """Token ids → text increment ("t<id>" words; offset decides
-        whether a leading separator is needed)."""
+    def _piece(self, ids: List[int], offset: int) -> str:
+        """Token ids → text increment. Default rendering is "t<id>"
+        words (the round-trip encoding the radix suites rely on); under
+        GRAMMAR_DECODE the tokens ARE ByteTokenizer byte ids, so pieces
+        render as the real UTF-8 text — the HTTP end-to-end grammar
+        tests read actual kubectl commands off the wire."""
+        if self._grammar is not None:
+            return self._grammar.tokenizer.decode(ids)
         text = " ".join(f"t{t}" for t in ids)
         return text if offset == 0 else " " + text
 
     def _result(self, req: _FakeReq, ids: List[int],
                 finish: str) -> EngineResult:
         return EngineResult(
-            text=" ".join(f"t{t}" for t in ids),
+            text=(self._grammar.tokenizer.decode(ids)
+                  if self._grammar is not None
+                  else " ".join(f"t{t}" for t in ids)),
             prompt_tokens=len(req.prompt.split()),
             completion_tokens=len(ids),
             finish_reason=finish,
@@ -1309,6 +1533,18 @@ class FakeChunkedEngine:
         tenant = (qctx.tenant if qctx is not None else "") or ANON_TENANT
         lane = (qctx.lane if qctx is not None
                 and qctx.lane in LANES else LANE_INTERACTIVE)
+        gpid = -1
+        if self._grammar is not None:
+            from ..constrain import current_grammar
+
+            gctx = current_grammar()
+            if gctx is not None and gctx.allowed_verbs:
+                # Mirror the batcher: a novel verb set compiles a
+                # variant FSM — keep that off the event loop.
+                gpid = await asyncio.to_thread(
+                    self._grammar.resolve, lane=lane, ctx=gctx)
+            else:
+                gpid = self._grammar.resolve(lane=lane, ctx=gctx)
         if self.faults is not None:
             burst = self.faults.tenant_flood()
             if burst:
@@ -1334,6 +1570,7 @@ class FakeChunkedEngine:
             # the client's first byte happened there too.
             ledger_delivered=len(resume_ids) if resume_ids else 0,
             ttft_exempt=bool(resume_ids),
+            gpid=gpid,
         )
         # put() raises TenantOverloaded (429) at the per-tenant cap and
         # EngineOverloaded when this tenant floods a full queue; a quiet

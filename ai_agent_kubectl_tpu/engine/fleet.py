@@ -1054,6 +1054,39 @@ class EngineFleet:
         agg["radix"] = radix if radix_seen else None
         return agg
 
+    def grammar_health(self) -> dict:
+        """Fleet rollup of the replicas' grammar views (ISSUE 11):
+        forced/masked/dead-end totals sum; the compiled-grammar
+        identity (hash, profile, state/class counts) passes through —
+        replicas run the same config, so their grammars are identical
+        by construction."""
+        agg: dict = {}
+        dead: dict = {}
+        seen = False
+        for rep in self.replicas:
+            fn = getattr(rep.engine, "grammar_health", None)
+            if not callable(fn):
+                continue
+            try:
+                g = fn() or None
+            except Exception:   # pragma: no cover - stopped replica
+                continue
+            if not g:
+                continue
+            seen = True
+            for k, v in g.items():
+                if k == "dead_ends_total":
+                    for ck, cv in (v or {}).items():
+                        dead[ck] = dead.get(ck, 0) + cv
+                elif k.endswith("_total") and isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+                else:
+                    agg[k] = v
+        if not seen:
+            return {}
+        agg["dead_ends_total"] = dead
+        return agg
+
     def slo_health(self) -> dict:
         """Fleet rollup of the replicas' SLO burn snapshots: per-window
         counts sum, burn rates recompute from the sums (rates don't
@@ -1260,6 +1293,10 @@ class EngineFleet:
         # counters sum across replicas (each owns its own pool).
         if any(s.get("kv_pool") for s in replica_stats):
             agg["kv_pool"] = self.kv_pool_health() or None
+        # Grammar (ISSUE 11): forced/masked/dead-end totals sum; the
+        # compiled identity passes through (replicas share one config).
+        if any(s.get("grammar") for s in replica_stats):
+            agg["grammar"] = self.grammar_health() or None
         fleet = self.fleet_health()
         fleet["replicas"] = per_replica
         agg["fleet"] = fleet

@@ -106,9 +106,16 @@ PACKED_CHUNK_VERSION = 2
 HEALTH_OK = 0
 HEALTH_NONFINITE = 1      # NaN/Inf in the slot's step logits
 HEALTH_TOKEN_RANGE = 2    # sampled token id outside [0, vocab_size)
+HEALTH_GRAMMAR_DEAD = 4   # grammar-constrained decode (ISSUE 11): the
+                          # slot's FSM state admits NO legal token — a
+                          # dead end the mask cannot sample out of. The
+                          # slot freezes before emitting anything and
+                          # rides the same quarantine lane as the other
+                          # health trips.
 
 _HEALTH_NAMES = ((HEALTH_NONFINITE, "nonfinite_logits"),
-                 (HEALTH_TOKEN_RANGE, "token_out_of_range"))
+                 (HEALTH_TOKEN_RANGE, "token_out_of_range"),
+                 (HEALTH_GRAMMAR_DEAD, "grammar_dead_end"))
 
 
 def describe_health(word: int) -> str:

@@ -110,7 +110,7 @@ def sample_token_traced(
         return jax.lax.cond(temperature > 0.0, _sampled, _greedy, None)
 
 
-def _sample_rows(logits, temperatures, active, draw):
+def _sample_rows(logits, temperatures, active, draw, mask=None):
     """Shared per-row decode-step scaffold: greedy argmax fallback,
     per-slot ``wants_sample`` mask (temperature > 0, intersected with the
     device-resident ``active`` mask so finished slots stop paying for
@@ -118,7 +118,23 @@ def _sample_rows(logits, temperatures, active, draw):
     entirely for all-greedy batches. ``draw`` maps temperature-scaled
     logits [batch, vocab] → sampled ids [batch]; it is the ONLY thing
     that differs between the shared-key and per-request-seeded paths, so
-    the distribution-parity-critical body lives here exactly once."""
+    the distribution-parity-critical body lives here exactly once.
+
+    ``mask`` (grammar-constrained decoding, ISSUE 11) is a [batch,
+    vocab] bool of legal tokens: illegal logits drop to -inf BEFORE the
+    greedy argmax and the draw, so both paths renormalize over the
+    masked support under the SAME key stream. Bit-reproducibility
+    contract: the gumbel trick (``categorical`` = argmax(logits +
+    gumbel)) consumes a vocab-shaped draw whether or not entries are
+    masked, so a masked sample equals the unmasked sample whenever the
+    unmasked winner was grammar-legal — the A/B parity the
+    GRAMMAR_DECODE acceptance tests assert (top_k must be 0: a top-k
+    subset changes the draw shape when the mask changes membership).
+    A row with an all-False mask argmaxes over all -inf (index 0); the
+    engine freezes such rows via the grammar dead-end health bit before
+    anything is emitted."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     wants_sample = temperatures > 0.0
     if active is not None:
@@ -140,6 +156,7 @@ def sample_tokens_batched(
     top_k: int = 0,
     top_p: float = 1.0,
     active: jnp.ndarray | None = None,  # [batch] bool — rows still decoding
+    mask: jnp.ndarray | None = None,    # [batch, vocab] grammar legality
 ) -> jnp.ndarray:
     """Shared-key per-row sampling: one PRNG key per step, split across
     the rows by the categorical. Since the seeded-sampling switch (ISSUE
@@ -162,6 +179,7 @@ def sample_tokens_batched(
         return _sample_rows(
             logits, temperatures, active,
             lambda scaled: _sample_filtered(scaled, key, top_k, top_p),
+            mask=mask,
         )
 
 
@@ -190,6 +208,7 @@ def sample_tokens_seeded(
     top_k: int = 0,
     top_p: float = 1.0,
     active: jnp.ndarray | None = None,  # [batch] bool — rows still decoding
+    mask: jnp.ndarray | None = None,    # [batch, vocab] grammar legality
 ) -> jnp.ndarray:
     """Per-row sampling under per-request RNG streams (``slot_keys``):
     the continuous-batching decode step and the admission first-token
@@ -210,7 +229,7 @@ def sample_tokens_seeded(
         )(scaled, slot_keys(seeds, ngen))
 
     with jax.named_scope("sampling"):
-        return _sample_rows(logits, temperatures, active, _draw)
+        return _sample_rows(logits, temperatures, active, _draw, mask=mask)
 
 
 def eos_mask(tokens: jnp.ndarray, eos_ids) -> jnp.ndarray:

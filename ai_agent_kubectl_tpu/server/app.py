@@ -253,6 +253,24 @@ class Service:
         self.metrics.cache_misses.inc()
         return command, result
 
+    def cache_key(self, sanitized_query: str) -> str:
+        """Response-cache key for one request. Under GRAMMAR_DECODE the
+        key is scoped by the request's grammar identity (clamped
+        profile + allowed-verbs) — without it, an interactive tenant's
+        MUTATING cached command would be served verbatim to a
+        readonly-clamped tenant, bypassing the grammar entirely. Off,
+        the key is the plain query (pre-ISSUE-11 cache behaviour)."""
+        if not self.cfg.grammar_decode:
+            return sanitized_query
+        from ..constrain import cache_scope, current_grammar
+        from ..engine.qos import current_qos
+
+        qctx = current_qos()
+        return sanitized_query + cache_scope(
+            self.cfg.grammar_profile,
+            qctx.lane if qctx is not None else None,
+            current_grammar())
+
     async def generate_command(
         self, sanitized_query: str
     ) -> tuple[str, bool, Optional[EngineResult], bool]:
@@ -280,7 +298,7 @@ class Service:
 
         try:
             command, from_cache = await self.cache.get_or_create(
-                sanitized_query, supplier
+                self.cache_key(sanitized_query), supplier
             )
         except EngineOverloaded:
             raise
@@ -477,7 +495,49 @@ async def qos_middleware(request: web.Request, handler):
         # the trace records only which kind keyed it.
         trace.event(f"qos: lane={ctx.lane} "
                     f"(tenant={'tier-key' if api_key else 'client-ip'})")
+    # Grammar intent (ISSUE 11): a request may LOWER itself to the
+    # read-only grammar (X-Grammar-Profile) and/or narrow the verb set
+    # (X-Allowed-Verbs, comma-separated) — validated HERE, at
+    # admission: unknown verbs and verbs outside the request's clamped
+    # profile are a 400, not a silent widening. Headers on a
+    # GRAMMAR_DECODE=false deployment are a 400 too — a restriction
+    # the engine cannot enforce must not be silently dropped.
+    g_profile = request.headers.get("X-Grammar-Profile")
+    g_verbs = request.headers.get("X-Allowed-Verbs")
+    gctx = None
+    if g_profile is not None or g_verbs is not None:
+        from ..constrain import GrammarContext, validate_restriction
+
+        if not svc.cfg.grammar_decode:
+            return _json_error(
+                400, "grammar restrictions require GRAMMAR_DECODE=true")
+        verbs = None
+        if g_verbs is not None:
+            verbs = frozenset(
+                v.strip().lower() for v in g_verbs.split(",")
+                if v.strip())
+        gctx = GrammarContext(
+            profile=(g_profile or "").strip().lower() or None,
+            allowed_verbs=verbs)
+        # ONE validation rule, shared with the engine runtime
+        # (constrain.validate_restriction): unknown profile, verbs
+        # outside the request's CLAMPED profile, or any verb
+        # restriction under the unenforceable permissive A/B profile —
+        # all refused here, at admission, never silently dropped.
+        err = validate_restriction(svc.cfg.grammar_profile, ctx.lane,
+                                   gctx)
+        if err is not None:
+            return _json_error(400, err)
+        if trace is not None:
+            trace.event(
+                f"grammar: request profile={gctx.profile or 'base'}"
+                + (f", {len(verbs)} allowed verbs" if verbs else ""))
     with use_qos(ctx):
+        if gctx is not None:
+            from ..constrain import use_grammar
+
+            with use_grammar(gctx):
+                return await handler(request)
         return await handler(request)
 
 
@@ -704,7 +764,8 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
 
     try:
         flight = asyncio.ensure_future(
-            svc.cache.get_or_create(sanitized_query, supplier)
+            svc.cache.get_or_create(svc.cache_key(sanitized_query),
+                                    supplier)
         )
         # Drain live tokens while the flight runs. Only our own supplier
         # fills token_q; a cache hit or a coalesced flight leaves it empty
@@ -890,6 +951,12 @@ async def handle_health(request: web.Request) -> web.Response:
     kph = getattr(svc.engine, "kv_pool_health", None)
     if callable(kph):
         kv_pool = kph() or None
+    # Grammar (ISSUE 11): compiled-grammar hash, state count, forced/
+    # masked totals — cheap host counters, same rule as the rest.
+    grammar = None
+    gh = getattr(svc.engine, "grammar_health", None)
+    if callable(gh):
+        grammar = gh() or None
     body = HealthResponse(
         status="healthy" if ready and breaker == "closed" else "degraded",
         engine=getattr(svc.engine, "name", "unknown"),
@@ -904,6 +971,7 @@ async def handle_health(request: web.Request) -> web.Response:
         qos=qos,
         slo=slo,
         kv_pool=kv_pool,
+        grammar=grammar,
     )
     # The HTTP status tracks engine readiness alone: an open breaker with
     # the engine process alive still serves (fallback and/or cache), and
@@ -1096,6 +1164,10 @@ async def handle_metrics(request: web.Request) -> web.Response:
         # sharing/COW/radix-hit counters — same delta-mirror pattern.
         if stats.get("kv_pool"):
             svc.metrics.observe_kv_pool(stats["kv_pool"])
+        # Grammar-constrained decoding (ISSUE 11): forced/masked token
+        # + dead-end counters — same delta-mirror pattern.
+        if stats.get("grammar"):
+            svc.metrics.observe_grammar(stats["grammar"])
     # Windowed throughput gauge: the batcher's own scheduler-side window
     # when it reports one (counts every finish, including streams), else
     # the service-side window fed by the response handlers.

@@ -451,6 +451,33 @@ class Metrics:
         )
         self._slo_seen: dict = {}
 
+        # Grammar-constrained decoding (ISSUE 11, constrain/): tokens
+        # delivered by forced-run fast-forward splices vs sampled under
+        # the device-side mask, and FSM dead ends by cause (``cause``
+        # is a closed small set: decode | admission). Delta-mirrored
+        # from stats()["grammar"] like the pipeline totals.
+        self.grammar_forced_tokens = Counter(
+            "grammar_forced_tokens_total",
+            "Tokens delivered by forced-run fast-forward splices "
+            "(single-successor FSM chains written as one suffix "
+            "prefill instead of decoded token-by-token)",
+            registry=r,
+        )
+        self.grammar_masked_steps = Counter(
+            "grammar_masked_steps_total",
+            "Decode steps sampled under the grammar's device-side "
+            "logit mask",
+            registry=r,
+        )
+        self.grammar_dead_ends = Counter(
+            "grammar_dead_end_total",
+            "Slots frozen in a grammar dead end (no legal token from "
+            "the current FSM state)",
+            ["cause"],
+            registry=r,
+        )
+        self._grammar_seen = {"forced": 0, "masked": 0, "dead": {}}
+
         # Request-lifecycle phase attribution (obs/trace.py): where a
         # request's wall time went. The ``phase`` label is drawn from the
         # fixed obs.PHASES allowlist — cardinality is bounded by
@@ -603,6 +630,26 @@ class Metrics:
             if lane_total:
                 self.goodput_ratio.labels(lane=lane).set(
                     row.get("delivered", 0) / lane_total)
+
+    def observe_grammar(self, grammar: dict) -> None:
+        """Delta-mirror the engine's grammar totals
+        (stats()["grammar"]) into Prometheus at scrape time — same
+        pattern as the pipeline/containment mirrors."""
+        seen = self._grammar_seen
+        for key, counter, total in (
+                ("forced", self.grammar_forced_tokens,
+                 grammar.get("forced_tokens_total", 0)),
+                ("masked", self.grammar_masked_steps,
+                 grammar.get("masked_steps_total", 0))):
+            if total > seen[key]:
+                counter.inc(total - seen[key])
+                seen[key] = total
+        for cause, total in (grammar.get("dead_ends_total") or {}).items():
+            prev = seen["dead"].get(cause, 0)
+            if total > prev:
+                self.grammar_dead_ends.labels(cause=cause).inc(
+                    total - prev)
+                seen["dead"][cause] = total
 
     def observe_slo(self, slo: dict) -> None:
         """Mirror the SLO burn snapshot (stats()["slo"]) into

@@ -92,7 +92,15 @@ def build_openapi() -> Dict:
             "responses": {
                 "200": _resp("CommandResponse", "Generated command with "
                              "generation-phase metadata"),
-                "400": _err("Invalid input query (pydantic validation)"),
+                "400": _err("Invalid input query (pydantic validation), "
+                            "or an invalid grammar restriction: "
+                            "X-Grammar-Profile outside the known "
+                            "profiles, X-Allowed-Verbs naming verbs "
+                            "outside the request's clamped grammar "
+                            "profile, or either header on a "
+                            "GRAMMAR_DECODE=false deployment (a "
+                            "restriction the engine cannot enforce is "
+                            "refused, never silently dropped)"),
                 "401": auth_err,
                 "410": _err("Request quarantined: it repeatedly poisoned "
                             "decode steps (NaN/Inf corruption or "

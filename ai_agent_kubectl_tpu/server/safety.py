@@ -20,6 +20,17 @@ from typing import Optional
 # Reference list (app.py:79) plus single & and |.
 _FORBIDDEN_CHARS = (";", "&", "|", "`", "$", "(", ")", "<", ">")
 
+#: verbs that open interactive shells or tunnels into the cluster — a
+#: natural-language command service must never execute them. The
+#: grammar subsystem (ai_agent_kubectl_tpu/constrain) makes them
+#: UNREPRESENTABLE when GRAMMAR_DECODE is on; this check is the outer
+#: defense-in-depth ring for the unconstrained path, and
+#: ``constrain.assert_safety_consistent`` cross-checks at boot that no
+#: grammar profile contains any of them.
+BLOCKED_VERBS = frozenset((
+    "attach", "cp", "debug", "edit", "exec", "port-forward", "proxy",
+))
+
 
 def unsafe_reason(command: str) -> Optional[str]:
     """Return None if safe, else a human-readable reason."""
@@ -35,6 +46,9 @@ def unsafe_reason(command: str) -> Optional[str]:
         return f"command failed shell lexing: {e}"
     if not parts or parts[0] != "kubectl":
         return "command does not tokenize to a kubectl invocation"
+    if len(parts) > 1 and parts[1] in BLOCKED_VERBS:
+        return (f"verb {parts[1]!r} is blocked (interactive shells and "
+                "tunnels are never executed by this service)")
     return None
 
 

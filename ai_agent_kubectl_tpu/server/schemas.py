@@ -111,3 +111,8 @@ class HealthResponse(BaseModel):
     # token counters. None = dense-KV engine (KV_POOL=false, a serving
     # mesh, or the single-sequence/fake/openai paths).
     kv_pool: Optional[Dict[str, Any]] = None
+    # Grammar-constrained decoding (ISSUE 11, constrain/): the active
+    # profile, compiled-grammar hash + state/class counts, forced vs
+    # masked token totals, and dead ends by cause. None = GRAMMAR_DECODE
+    # off or an engine without the subsystem.
+    grammar: Optional[Dict[str, Any]] = None

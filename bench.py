@@ -306,6 +306,95 @@ async def phase_7b(batch_size: int, max_seq: int, kv_quant: str,
     }
 
 
+#: kubectl query set for the grammar sweep (ISSUE 11): the shapes the
+#: service actually serves — short NL asks that decode to one command.
+GRAMMAR_QUERIES = [
+    "list all pods in kube-system",
+    "describe the web deployment",
+    "show logs for pod web-1 with the last 100 lines",
+    "get services across all namespaces",
+    "scale deployment web to 3 replicas",
+    "show nodes with labels",
+    "get the configmap app-config as yaml",
+    "top pods by cpu",
+    "delete the failed job importer-42",
+    "get events sorted by timestamp",
+    "describe service frontend in staging",
+    "list persistent volume claims",
+]
+
+
+async def phase_grammar7b(batch_size: int, max_seq: int, kv_quant: str,
+                          grammar: bool, chunk_len: int = 16) -> dict:
+    """One rung of the ISSUE 11 grammar sweep: the kubectl query set
+    decoded with GRAMMAR_DECODE off vs on at the bs=48 geometry,
+    recording decode-steps-per-command and tok/s. The claim under test:
+    most of a kubectl command is FORCED given the grammar (the
+    "kubectl " head, flag completions, resource-kind tails), so the
+    constrained rung should spend >=2x fewer decode steps per command —
+    forced tokens ride suffix prefills, never decode steps — stacking
+    multiplicatively with the pool's capacity win."""
+    import jax
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "not on TPU"}
+
+    cfg7 = get_config("gemma-7b-it")
+    tok7, _ = make_tokenizer(cfg7)
+    log(f"bench: grammar7b rung bs={batch_size} grammar={grammar}")
+    eng = BatchedJaxEngine(
+        cfg7,
+        tokenizer=tok7,
+        dtype="bfloat16",
+        quant="int8",
+        kv_quant=kv_quant,
+        max_seq_len=max_seq,
+        prefill_buckets=(64, 128),
+        batch_size=batch_size,
+        chunk_len=chunk_len,
+        grammar_decode=grammar,
+    )
+    t0 = time.monotonic()
+    await eng.start()
+    log(f"bench: grammar7b engine ready in {time.monotonic() - t0:.1f}s")
+    prompts = [render_prompt(q) for q in GRAMMAR_QUERIES]
+    n_cmds = 0
+    n_tokens = 0
+    t0 = time.monotonic()
+    for _ in range(2):
+        results = await asyncio.gather(*[
+            eng.generate(p, max_tokens=48, temperature=0.0)
+            for p in prompts])
+        n_cmds += len(results)
+        n_tokens += sum(r.completion_tokens for r in results)
+    wall = time.monotonic() - t0
+    stats = eng.stats()
+    gh = stats.get("grammar") or {}
+    await eng.stop()
+    # Decode steps actually spent: masked steps when the grammar is on
+    # (forced tokens ride prefills); every generated token otherwise.
+    steps = gh.get("masked_steps_total", n_tokens) if grammar else n_tokens
+    return {
+        "model": "gemma-7b-it",
+        "batch_size": batch_size,
+        "kv_quant": kv_quant,
+        "grammar": grammar,
+        "commands": n_cmds,
+        "completion_tokens": n_tokens,
+        "decode_steps_per_command": round(steps / max(1, n_cmds), 2),
+        "forced_tokens_total": gh.get("forced_tokens_total", 0),
+        "forced_token_ratio": round(
+            gh.get("forced_tokens_total", 0) / max(1, n_tokens), 4),
+        "fast_forward_splices": gh.get("fast_forward_splices_total", 0),
+        "tokens_per_sec_per_chip": round(
+            n_tokens / wall / len(jax.devices()), 2),
+    }
+
+
 async def phase_pipe7b(batch_size: int, max_seq: int, kv_quant: str,
                        pipe_depth: int, chunk_len: int = 16) -> dict:
     """One rung of the CHUNK_PIPE_DEPTH sweep (ISSUE 4): serving
@@ -755,6 +844,27 @@ def orchestrate() -> dict:
         if kv_sweep["pool"] or kv_sweep["dense"]:
             extra7["kv_pool_sweep"] = kv_sweep
 
+        # Grammar-constrained decode sweep (ISSUE 11): the kubectl
+        # query set with the grammar off vs on at the bs=48 rung —
+        # decode-steps-per-command is the headline (forced runs ride
+        # prefills, so the constrained rung should halve it or better).
+        gram_sweep: dict = {}
+        for mode in ("off", "on"):
+            rg = _run_phase(
+                ["--phase", "grammar7b", "--bs", "48",
+                 "--max-seq", str(extra7["max_seq_len"]),
+                 "--kv-quant", extra7["kv_quant"],
+                 "--grammar", mode],
+                timeout=1800)
+            if rg is not None and "skipped" not in rg:
+                gram_sweep[mode] = {
+                    k: rg.get(k) for k in (
+                        "decode_steps_per_command", "forced_token_ratio",
+                        "fast_forward_splices", "tokens_per_sec_per_chip",
+                        "completion_tokens")}
+        if gram_sweep:
+            extra7["grammar_sweep"] = gram_sweep
+
     rmoe = _run_phase(["--phase", "moe"], timeout=2400)
 
     r2 = _run_phase(["--phase", "2b"], timeout=2400)
@@ -785,7 +895,8 @@ def orchestrate() -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", choices=["7b", "2b", "moe", "attr7b",
-                                        "pipe7b", "paged7b"],
+                                        "pipe7b", "paged7b",
+                                        "grammar7b"],
                     default=None)
     ap.add_argument("--bs", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=256)
@@ -795,6 +906,7 @@ def main() -> None:
     ap.add_argument("--kv-pool", choices=["on", "off"], default="on")
     ap.add_argument("--pool-envelope-bs", type=int, default=0)
     ap.add_argument("--agent-loop", action="store_true")
+    ap.add_argument("--grammar", choices=["on", "off"], default="off")
     ns = ap.parse_args()
 
     if ns.phase == "7b":
@@ -809,6 +921,10 @@ def main() -> None:
         result = asyncio.run(
             phase_pipe7b(ns.bs, ns.max_seq, ns.kv_quant, ns.pipe_depth,
                          ns.chunk_len))
+    elif ns.phase == "grammar7b":
+        result = asyncio.run(
+            phase_grammar7b(ns.bs, ns.max_seq, ns.kv_quant,
+                            ns.grammar == "on", ns.chunk_len))
     elif ns.phase == "attr7b":
         result = phase_attr7b(ns.bs, ns.max_seq, ns.kv_quant)
     elif ns.phase == "2b":

@@ -132,6 +132,7 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
         log(f"  wasted steps / consumed chunk {wasted / consumed:>8.2f}")
     print_containment_summary(gauges)
     print_kv_pool_summary(gauges)
+    print_grammar_summary(gauges)
     print_fleet_summary(gauges)
     print_qos_summary(gauges)
     print_goodput_summary(gauges)
@@ -198,6 +199,27 @@ def print_kv_pool_summary(gauges: Dict[str, float]) -> None:
     log(f"  radix miss tokens total     {miss:>8.0f}")
     if hit + miss:
         log(f"  radix hit rate              {hit / (hit + miss):>8.1%}")
+
+
+def print_grammar_summary(gauges: Dict[str, float]) -> None:
+    """Grammar-constrained decoding (ISSUE 11) from the same /metrics
+    scrape: forced vs masked token totals and the forced-token ratio —
+    the fraction of generated tokens delivered by forced-run
+    fast-forward splices instead of decode steps (the decode-step cut
+    the subsystem exists for)."""
+    forced = gauges.get("grammar_forced_tokens_total", 0.0)
+    masked = gauges.get("grammar_masked_steps_total", 0.0)
+    dead = _sum_labelled(gauges, "grammar_dead_end_total")
+    if not (forced or masked or dead):
+        return      # GRAMMAR_DECODE off
+    log("probe[grammar]: grammar-constrained decode")
+    log(f"  forced tokens total         {forced:>8.0f}")
+    log(f"  masked decode steps total   {masked:>8.0f}")
+    if forced + masked:
+        log(f"  forced-token ratio          "
+            f"{forced / (forced + masked):>8.1%}")
+    for k, v in sorted(dead.items()):
+        log(f"  dead ends {k:<17} {v:>8.0f}")
 
 
 def print_fleet_summary(gauges: Dict[str, float]) -> None:
