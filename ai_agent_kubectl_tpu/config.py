@@ -241,6 +241,27 @@ class ServiceConfig:
     # in-flight speculative chunks would decode anyway (their compute
     # is sunk; discarding them must buy more than it costs).
     grammar_forced_run_min: int = 4         # GRAMMAR_FORCED_RUN_MIN
+    # --- speculative decoding (ISSUE 12; engine/batcher.py) ---
+    # Run a small draft model (the 2B) that proposes SPEC_DRAFT_K tokens
+    # per slot per verify step; ONE 7B forward over the k+1-token window
+    # then verifies them all — more transcript tokens per 7B weight
+    # read, the remaining single-chip lever once decode is pinned at the
+    # int8 weight-read floor. Verification is exact-match against the
+    # 7B's own seeded sample, so transcripts are byte-identical to
+    # SPEC_DECODE=false at any k (the acceptance gate). Requires
+    # DEVICE_TERMINATION (the accept/reject fold rides the chunk carry)
+    # and the KV pool (dense/mesh layouts fall back to plain decode).
+    spec_decode: bool = False               # SPEC_DECODE
+    # Draft tokens proposed per verify step (>= 1). Throughput =
+    # accepted-rate-dependent; greedy kubectl outputs accept at very
+    # high rates, and acceptance is a first-class /metrics signal
+    # (spec_acceptance_ratio).
+    spec_draft_k: int = 4                   # SPEC_DRAFT_K
+    # Draft model registry name; must share the target's tokenizer /
+    # vocab (validated at boot).
+    spec_draft_model: str = "gemma-2b-it"   # SPEC_DRAFT_MODEL
+    # Draft checkpoint dir (unset = random init, toy/dev mode only).
+    spec_draft_path: Optional[str] = None   # SPEC_DRAFT_PATH
     hbm_prefix_cache: bool = True           # HBM_PREFIX_CACHE (system-prompt prefix KV)
     # Scheduler watchdog: if the batch scheduler makes no progress for this
     # long while work is in flight (hung device dispatch), the engine is
@@ -470,6 +491,36 @@ class ServiceConfig:
             from .constrain import assert_safety_consistent
 
             assert_safety_consistent()
+        # Speculative-decode knobs (ISSUE 12): an impossible combination
+        # or an unknown/mismatched draft model must refuse to boot, not
+        # silently serve plain decode behind a knob that says otherwise.
+        if self.spec_decode:
+            if not self.device_termination:
+                raise ValueError(
+                    "SPEC_DECODE requires DEVICE_TERMINATION=true (the "
+                    "accept/reject fold rides the decode chunk's carry)")
+            if self.spec_draft_k < 1:
+                raise ValueError(
+                    f"SPEC_DRAFT_K must be >= 1, got {self.spec_draft_k}")
+            from .models.config import get_config as _get_model_config
+
+            try:
+                draft = _get_model_config(self.spec_draft_model)
+            except KeyError:
+                raise ValueError(
+                    f"SPEC_DRAFT_MODEL {self.spec_draft_model!r} is not "
+                    f"a known model registry name") from None
+            try:
+                target = _get_model_config(self.model_name)
+            except KeyError:
+                target = None   # MODEL_NAME errors are the engine's job
+            if (target is not None
+                    and draft.vocab_size != target.vocab_size):
+                raise ValueError(
+                    f"SPEC_DRAFT_MODEL {self.spec_draft_model!r} "
+                    f"(vocab {draft.vocab_size}) does not share "
+                    f"{self.model_name!r}'s vocab ({target.vocab_size}) "
+                    f"— draft and verifier must use one tokenizer")
 
     @property
     def tenant_tier_map(self) -> dict:
@@ -551,6 +602,10 @@ class ServiceConfig:
             grammar_profile=(_env_str("GRAMMAR_PROFILE", "default")
                              or "default").lower(),
             grammar_forced_run_min=_env_int("GRAMMAR_FORCED_RUN_MIN", 4),
+            spec_decode=_env_bool("SPEC_DECODE", False),
+            spec_draft_k=_env_int("SPEC_DRAFT_K", 4),
+            spec_draft_model=_env_str("SPEC_DRAFT_MODEL", "gemma-2b-it"),
+            spec_draft_path=_env_str("SPEC_DRAFT_PATH", None),
             hbm_prefix_cache=_env_bool("HBM_PREFIX_CACHE", True),
             engine_watchdog_secs=_env_float("ENGINE_WATCHDOG_SECS", 120.0),
             engine_startup_grace_secs=_env_float(

@@ -25,10 +25,10 @@ from typing import AsyncIterator, Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..obs.ledger import (CLASS_DELIVERED, CLASS_HEDGE_LOSER,
-                          CLASS_PREEMPTED, CLASS_QUARANTINE_BURN,
-                          CLASS_REPLAYED, CLASS_WASTED_MASKED,
-                          GoodputLedger)
+from ..obs.ledger import (CLASS_DELIVERED, CLASS_DRAFT_REJECTED,
+                          CLASS_HEDGE_LOSER, CLASS_PREEMPTED,
+                          CLASS_QUARANTINE_BURN, CLASS_REPLAYED,
+                          CLASS_WASTED_MASKED, GoodputLedger)
 from ..obs.slo import SLO_QUEUE_WAIT, SLO_TTFT, SloEngine
 from ..obs.trace import current_trace
 from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
@@ -246,6 +246,9 @@ class FakeChunkedEngine:
                  grammar_decode: bool = False,
                  grammar_profile: str = "default",
                  grammar_forced_run_min: int = 4,
+                 spec_decode: bool = False,
+                 spec_draft_k: int = 4,
+                 spec_fake_miss: int = 3,
                  max_seq_len: int = 256,
                  faults=None,
                  stream_fn: Optional[Callable[[str], List[int]]] = None):
@@ -351,6 +354,75 @@ class FakeChunkedEngine:
         self._grammar_masked = 0
         self._grammar_dead_ends: Dict[str, int] = {}
         self._grammar_ff_splices = 0
+        # Speculative decoding mirror (ISSUE 12): the fake's "draft
+        # model" is a deterministic oracle that predicts the scripted
+        # stream's next token except at miss indices
+        # (``spec_fake_miss`` = every ~Nth draft is wrong; 0 = a
+        # perfect draft) — so the accept/reject machinery, the packed
+        # v3 lanes, the draft_rejected billing, and the draft:die
+        # degradation all run in tier-1 with a dialable acceptance
+        # rate, while spec on/off byte-identity stays structural (the
+        # emitted tokens are the scripted stream either way, which is
+        # exactly the real engine's exact-match-verification
+        # guarantee).
+        if spec_decode and not device_termination:
+            raise ValueError("SPEC_DECODE requires DEVICE_TERMINATION")
+        if spec_decode and spec_draft_k < 1:
+            raise ValueError(
+                f"SPEC_DRAFT_K must be >= 1, got {spec_draft_k}")
+        self.spec_decode = bool(spec_decode)
+        self.spec_draft_k = int(spec_draft_k)
+        self.spec_fake_miss = max(0, int(spec_fake_miss))
+        self._use_spec = self.spec_decode
+        self._spec_live = self.spec_decode
+        self._spec_steps = (max(1, chunk_len // (spec_draft_k + 1))
+                            if self.spec_decode else 0)
+        self._chunk_tokens = (self._spec_steps * (spec_draft_k + 1)
+                              if self.spec_decode else chunk_len)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_degraded = 0
+
+    # ----------------------------------- speculative decoding (mirror)
+
+    def _spec_active(self) -> bool:
+        return self._use_spec and self._spec_live
+
+    def _chunk_waste_bound(self) -> int:
+        """Mirror of the batcher's: per-in-flight-chunk bound on counted
+        steps for the preempt/disconnect waste caps (spec chunks are
+        ``_chunk_tokens`` wide, possibly > chunk_len)."""
+        if self._use_spec:
+            return max(self.chunk_len, self._chunk_tokens)
+        return self.chunk_len
+
+    def _spec_miss(self, req: _FakeReq, idx: int) -> bool:
+        """Deterministic draft-miss oracle: does the fake's draft model
+        mispredict the scripted stream at index ``idx``? Keyed on
+        (seed, idx) so replays/preemptions reproduce the same
+        acceptance pattern the original run had."""
+        if self.spec_fake_miss <= 0:
+            return False
+        return (idx * 2654435761 + req.seed) % self.spec_fake_miss == 0
+
+    def spec_health(self) -> Optional[dict]:
+        """Cheap speculative-decode view for /health (mirror of the
+        batcher's)."""
+        if not self.spec_decode:
+            return None
+        drafted = self._spec_drafted
+        return {
+            "enabled": self.spec_decode,
+            "active": self._spec_active(),
+            "draft_model": "fake-draft",
+            "k": self.spec_draft_k,
+            "verify_steps_per_chunk": self._spec_steps,
+            "drafted_tokens_total": drafted,
+            "accepted_tokens_total": self._spec_accepted,
+            "acceptance_ratio": (round(self._spec_accepted / drafted, 4)
+                                 if drafted else None),
+            "degraded_total": self._spec_degraded,
+        }
 
     # ------------------------------------- block-paged KV pool (mirror)
 
@@ -409,13 +481,17 @@ class FakeChunkedEngine:
         blocks, _ = self._pool_map_prefix(chain, match_all=bool(gen))
         return blocks, basis
 
-    def _pool_ensure_coverage(self, slot: _FakeSlot) -> bool:
+    def _pool_ensure_coverage(self, slot: _FakeSlot,
+                              chunk_tokens: Optional[int] = None) -> bool:
         """Grow the slot's chain to cover the next chunk's writes
         (mirror of the batcher's dispatch-time growth; starvation
-        truncates the request at its current length, never corrupts)."""
+        truncates the request at its current length, never corrupts).
+        ``chunk_tokens`` is the dispatching chunk's own token capacity
+        (wider under speculative decode)."""
         if self._pool is None or slot.pool_starved:
             return not slot.pool_starved
-        target = min(len(slot.pool_ids) + slot.dev_ngen + self.chunk_len,
+        target = min(len(slot.pool_ids) + slot.dev_ngen
+                     + (chunk_tokens or self.chunk_len),
                      len(slot.pool_ids) + slot.req.max_tokens)
         need = pages_for(target, self.kv_pool_page)
         while len(slot.blocks) < need:
@@ -505,7 +581,8 @@ class FakeChunkedEngine:
         if cap <= 0:
             return
         run, ends_eos, end_gs = self._grammar.forced_run(slot.gs, cap)
-        covered = slot.decode_chunks_inflight * self.chunk_len
+        covered = slot.decode_chunks_inflight * (
+            self._chunk_tokens if self._spec_active() else self.chunk_len)
         net = len(run) - covered
         if net < self.grammar_forced_run_min and not (
                 ends_eos and run and net > 0):
@@ -647,6 +724,7 @@ class FakeChunkedEngine:
             "ledger": self.ledger.snapshot(),
             "slo": self._slo.snapshot(),
             "grammar": self.grammar_health(),
+            "spec": self.spec_health(),
         }
 
     # ------------------------------------------ telemetry plane (ISSUE 8)
@@ -842,8 +920,8 @@ class FakeChunkedEngine:
         if self.device_termination and slot.decode_chunks_inflight > 0:
             remaining = max(0, req.max_tokens - len(slot.emitted))
             self._bill_waste(min(
-                slot.decode_chunks_inflight * self.chunk_len, remaining),
-                req)
+                slot.decode_chunks_inflight * self._chunk_waste_bound(),
+                remaining), req)
         self._preemptions += 1
         self._preempted_tokens += len(slot.emitted)
         self._preempt_times.append(req.preempt_t0)
@@ -1069,12 +1147,32 @@ class FakeChunkedEngine:
         mask exactly like the jitted scan does, and pack one buffer.
         decode:nan corruption mirrors the jitted detection: the corrupt
         slot's health bit sets, its row repeats the carry token, and
-        (device termination) it freezes before counting anything."""
-        N, C = self.batch_size, self.chunk_len
+        (device termination) it freezes before counting anything.
+
+        Speculative decode (ISSUE 12): a spec chunk runs
+        ``_spec_steps`` draft/verify windows instead — each window
+        emits 1..k+1 tokens depending on where the deterministic
+        draft-miss oracle first disagrees with the scripted stream —
+        and packs the wider row plus the v3 drafted/accepted lanes.
+        The EMITTED tokens are the scripted stream either way (the
+        exact-match-verification guarantee), so spec on/off transcripts
+        are byte-identical by construction here too."""
+        if (self._spec_active() and self.faults is not None
+                and self.faults.draft_die()):
+            # draft:die — the draft engine is gone; degrade to plain
+            # decode mid-stream without failing anything (mirror of
+            # the batcher).
+            self._spec_live = False
+            self._spec_degraded += 1
+        spec = self._spec_active() and self.device_termination
+        N = self.batch_size
+        C = self._chunk_tokens if spec else self.chunk_len
         toks = np.zeros((N, C), np.int32)
         done = np.zeros((N,), bool)
         lengths = np.zeros((N,), np.int32)
         health = np.zeros((N,), np.int32)
+        drafted = np.zeros((N,), np.int32)
+        accepted = np.zeros((N,), np.int32)
         corrupt: set = set()
         if self.faults is not None:
             corrupt = set(self.faults.decode_nan_slots(
@@ -1085,7 +1183,7 @@ class FakeChunkedEngine:
             if slot is None:
                 continue
             if (self._pool is not None
-                    and not self._pool_ensure_coverage(slot)):
+                    and not self._pool_ensure_coverage(slot, C)):
                 # Pool starved even after radix eviction: the slot is
                 # excluded from this chunk and finishes at its current
                 # length once its in-flight chunks drain (mirror of the
@@ -1108,6 +1206,11 @@ class FakeChunkedEngine:
                     continue
             grammar_on = (self._grammar is not None
                           and slot.req.gpid >= 0)
+            if spec:
+                self._spec_slot_rows(i, slot, toks, done, lengths,
+                                     health, drafted, accepted,
+                                     grammar_on, live)
+                continue
             for step in range(C):
                 if self.device_termination:
                     if not live:
@@ -1156,13 +1259,65 @@ class FakeChunkedEngine:
             1 for s in self._slots if s is not None and s.dev_active
         ) if self.device_termination else sum(
             s is not None for s in self._slots)
-        packed = pack_chunk(toks, done, lengths, n_alive, health=health)
-        self._inflight.append(("chunk", packed, snapshot))
+        packed = pack_chunk(toks, done, lengths, n_alive, health=health,
+                            drafted=drafted if spec else None,
+                            accepted=accepted if spec else None)
+        self._inflight.append(("chunk", packed, snapshot, C, spec))
         self._chunks_dispatched += 1
+
+    def _spec_slot_rows(self, i: int, slot: _FakeSlot, toks, done,
+                        lengths, health, drafted, accepted,
+                        grammar_on: bool, live: bool) -> None:
+        """One slot's speculative chunk: ``_spec_steps`` windows of
+        (carry + k drafts), each accepting tokens until the draft-miss
+        oracle first disagrees — the same per-position termination /
+        grammar / EOS fold as the plain loop, writing compacted rows
+        through a cursor exactly like the jitted spec scan."""
+        K = self.spec_draft_k
+        toks[i, :] = slot.last_tok      # garbage-by-contract fill
+        cur = 0
+        for _it in range(self._spec_steps):
+            if not live:
+                break
+            drafted[i] += K
+            idx0 = slot.dev_idx
+            for j in range(K + 1):
+                if j >= 1 and self._spec_miss(slot.req, idx0 + j - 1):
+                    # Draft j-1 mispredicted: this window's later
+                    # positions were conditioned on the wrong token —
+                    # dead for the window, re-drafted next one.
+                    break
+                nxt = self._stream_at(slot.req.stream, slot.dev_idx)
+                if grammar_on:
+                    picked = self._grammar_pick(slot.dev_gs, nxt)
+                    if picked is None:
+                        health[i] |= HEALTH_GRAMMAR_DEAD
+                        live = False
+                        break
+                    nxt = picked
+                toks[i, cur] = nxt
+                slot.last_tok = nxt
+                if nxt in self.eos_ids:
+                    live = False
+                    break
+                if grammar_on:
+                    slot.dev_gs = self._grammar.advance(slot.dev_gs,
+                                                        nxt)
+                slot.dev_idx += 1
+                slot.dev_ngen += 1
+                cur += 1
+                if j >= 1:
+                    accepted[i] += 1
+                if slot.dev_ngen >= slot.req.max_tokens:
+                    live = False
+                    break
+        done[i] = not live
+        slot.dev_active = live
+        lengths[i] = slot.dev_ngen
 
     def _prune_dead_chunks(self) -> None:
         while self._inflight:
-            _, _, snapshot = self._inflight[0]
+            snapshot = self._inflight[0][2]
             live = any(
                 snap is not None and self._slots[i] is not None
                 and self._slots[i].req is snap
@@ -1180,16 +1335,34 @@ class FakeChunkedEngine:
             self._chunks_pruned += 1
 
     def _consume_oldest(self) -> None:
-        _, packed, snapshot = self._inflight.pop(0)
+        _, packed, snapshot, ct, is_spec = self._inflight.pop(0)
         if self.faults is not None:
             # decode:poison_step — step-wide fault from the fetch, routed
             # into the bisecting containment by the loop's except.
             self.faults.poison_fetch(
                 [r.prompt if r is not None else None for r in snapshot])
         self._fetches += 1          # the single fetch per chunk
-        res = unpack_chunk(packed, self.batch_size, self.chunk_len)
+        res = unpack_chunk(packed, self.batch_size, ct, spec=is_spec)
         self._chunks_consumed += 1
         self._last_n_alive = res.n_alive
+        # Speculative accounting (mirror of the batcher): acceptance
+        # counters + the draft_rejected waste class, billed BEFORE the
+        # health-trip early return so the books balance under drills.
+        if is_spec and res.drafted is not None:
+            for i in range(self.batch_size):
+                req_i = snapshot[i]
+                if req_i is None:
+                    continue
+                d, a = int(res.drafted[i]), int(res.accepted[i])
+                if d <= 0:
+                    continue
+                self._spec_drafted += d
+                self._spec_accepted += a
+                if d > a:
+                    self.ledger.record(
+                        CLASS_DRAFT_REJECTED, d - a,
+                        lane=getattr(req_i, "lane", LANE_INTERACTIVE),
+                        tenant=req_i.tenant)
         # Slot-health quarantine: nothing from a poisoned chunk is
         # emitted; replay regenerates the innocents bit-identically.
         tripped = [
@@ -1222,7 +1395,7 @@ class FakeChunkedEngine:
             if self.device_termination:
                 new_ids, finish = consume_chunk_row(
                     res.tokens[i], bool(res.done[i]), int(res.lengths[i]),
-                    len(slot.emitted), self.chunk_len, self.eos_ids)
+                    len(slot.emitted), ct, self.eos_ids)
             else:
                 new_ids, finish, wasted = scan_chunk_row(
                     res.tokens[i], len(slot.emitted), self.eos_ids,
@@ -1453,8 +1626,8 @@ class FakeChunkedEngine:
                 and slot.decode_chunks_inflight > 0):
             remaining = max(0, slot.req.max_tokens - len(slot.emitted))
             self._bill_waste(min(
-                slot.decode_chunks_inflight * self.chunk_len, remaining),
-                slot.req)
+                slot.decode_chunks_inflight * self._chunk_waste_bound(),
+                remaining), slot.req)
         # Ledger + TTFT SLO (mirror of the batcher's _finish).
         self._bill_delivered(slot.req, len(slot.emitted))
         if error is not None:

@@ -1087,6 +1087,42 @@ class EngineFleet:
         agg["dead_ends_total"] = dead
         return agg
 
+    def spec_health(self) -> dict:
+        """Fleet rollup of the replicas' speculative-decode views
+        (ISSUE 12): drafted/accepted/degraded totals sum, the
+        acceptance ratio re-derives from the summed totals (ratios
+        don't average), identity fields (draft model, k) pass through
+        — replicas run one config — and ``active`` is AND-ed (one
+        replica's dead draft shows as a fleet-level degradation)."""
+        agg: dict = {}
+        seen = False
+        active = True
+        for rep in self.replicas:
+            fn = getattr(rep.engine, "spec_health", None)
+            if not callable(fn):
+                continue
+            try:
+                s = fn() or None
+            except Exception:   # pragma: no cover - stopped replica
+                continue
+            if not s:
+                continue
+            seen = True
+            active = active and bool(s.get("active"))
+            for k, v in s.items():
+                if k.endswith("_total") and isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+                elif k not in ("active", "acceptance_ratio"):
+                    agg[k] = v
+        if not seen:
+            return {}
+        agg["active"] = active
+        drafted = agg.get("drafted_tokens_total", 0)
+        agg["acceptance_ratio"] = (
+            round(agg.get("accepted_tokens_total", 0) / drafted, 4)
+            if drafted else None)
+        return agg
+
     def slo_health(self) -> dict:
         """Fleet rollup of the replicas' SLO burn snapshots: per-window
         counts sum, burn rates recompute from the sums (rates don't
@@ -1297,6 +1333,10 @@ class EngineFleet:
         # compiled identity passes through (replicas share one config).
         if any(s.get("grammar") for s in replica_stats):
             agg["grammar"] = self.grammar_health() or None
+        # Speculative decoding (ISSUE 12): drafted/accepted totals sum,
+        # acceptance re-derived from the sums.
+        if any(s.get("spec") for s in replica_stats):
+            agg["spec"] = self.spec_health() or None
         fleet = self.fleet_health()
         fleet["replicas"] = per_replica
         agg["fleet"] = fleet

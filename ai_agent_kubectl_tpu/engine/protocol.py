@@ -66,14 +66,16 @@ class RequestQuarantined(RuntimeError):
 
 
 # ---------------------------------------------------------------------------
-# Packed chunk-result contract (decode pipeline seam) — v2
+# Packed chunk-result contract (decode pipeline seam) — v3
 #
 # A decode chunk returns ONE flat int32 buffer so tokens, termination,
 # occupancy, AND per-slot health cross the host↔device link in a single
 # fetch:
 #
 #     [ tokens (n_slots × chunk_len) | done_mask (n_slots)
-#       | live_lengths (n_slots) | health (n_slots) | n_alive (1) ]
+#       | live_lengths (n_slots) | health (n_slots)
+#       | spec_drafted (n_slots) | spec_accepted (n_slots)   (spec only)
+#       | n_alive (1) ]
 #
 # - ``tokens[i]``: the chunk's sampled token ids for slot i (entries past
 #   the slot's termination point repeat its last counted token — garbage
@@ -90,6 +92,15 @@ class RequestQuarantined(RuntimeError):
 #   chunk (no further sampling/KV writes) and its garbage is never
 #   counted in ``live_lengths`` — the scheduler's quarantine pass
 #   (engine/containment.py) takes it from there. 0 = healthy.
+# - ``spec_drafted`` / ``spec_accepted`` (v3, speculative decoding —
+#   ISSUE 12): how many draft-model proposals this chunk drafted for
+#   slot i and how many of them the verifier accepted (an accepted draft
+#   = a transcript token that did NOT cost its own target forward). The
+#   two lanes ride the packed buffer only when the chunk program runs
+#   the draft/verify body — ``pack_chunk``/``unpack_chunk`` take
+#   ``spec=True`` — so plain decode pays nothing for the contract
+#   extension. Acceptance rate is derived host-side and billed into the
+#   goodput ledger (rejected drafts are a first-class waste class).
 # - ``n_alive``: slots still decoding after the chunk — the scheduler's
 #   early-retirement signal.
 #
@@ -98,7 +109,7 @@ class RequestQuarantined(RuntimeError):
 # on the fake engine exercise the real contract.
 # ---------------------------------------------------------------------------
 
-PACKED_CHUNK_VERSION = 2
+PACKED_CHUNK_VERSION = 3
 
 #: health-word bits (per slot, OR-able). Device-side detection writes
 #: them inside the jitted chunk scan; the fake engine's numpy twin writes
@@ -127,9 +138,11 @@ def describe_health(word: int) -> str:
     return "|".join(parts) or "ok"
 
 
-def packed_chunk_size(n_slots: int, chunk_len: int) -> int:
-    """Flat length of one packed chunk buffer."""
-    return n_slots * chunk_len + 3 * n_slots + 1
+def packed_chunk_size(n_slots: int, chunk_len: int,
+                      spec: bool = False) -> int:
+    """Flat length of one packed chunk buffer (``spec`` adds the two
+    per-slot drafted/accepted lanes of the v3 speculative contract)."""
+    return n_slots * chunk_len + (5 if spec else 3) * n_slots + 1
 
 
 @dataclass
@@ -141,42 +154,63 @@ class ChunkResult:
     lengths: np.ndarray     # [n_slots] int32 cumulative completion tokens
     health: np.ndarray      # [n_slots] int32 health bitmask (0 = healthy)
     n_alive: int
+    #: speculative decoding (v3): draft tokens proposed / accepted for
+    #: each slot THIS chunk. All-zero when the chunk ran plain decode.
+    drafted: Optional[np.ndarray] = None   # [n_slots] int32
+    accepted: Optional[np.ndarray] = None  # [n_slots] int32
 
 
-def pack_chunk(tokens, done, lengths, n_alive, *, health=None, xp=np):
+def pack_chunk(tokens, done, lengths, n_alive, *, health=None,
+               drafted=None, accepted=None, xp=np):
     """Flatten one chunk's results into the single-fetch buffer.
 
     ``xp`` is the array namespace — ``numpy`` for the fake engine,
     ``jax.numpy`` inside the jitted chunk program (the concatenate then
     happens on device and the scheduler fetches one array). ``health``
-    defaults to all-healthy for callers predating the v2 lane."""
+    defaults to all-healthy for callers predating the v2 lane;
+    ``drafted``/``accepted`` (v3) ride only when the chunk ran the
+    speculative draft/verify body — pass both or neither."""
     done = done.astype(xp.int32)
     if health is None:
         health = xp.zeros_like(done)
-    return xp.concatenate([
+    if (drafted is None) != (accepted is None):
+        raise ValueError("spec lanes travel together: pass both "
+                         "drafted and accepted, or neither")
+    parts = [
         xp.reshape(tokens, (-1,)).astype(xp.int32),
         done,
         lengths.astype(xp.int32),
         health.astype(xp.int32),
-        xp.reshape(xp.asarray(n_alive, dtype=xp.int32), (1,)),
-    ])
+    ]
+    if drafted is not None:
+        parts.append(drafted.astype(xp.int32))
+        parts.append(accepted.astype(xp.int32))
+    parts.append(xp.reshape(xp.asarray(n_alive, dtype=xp.int32), (1,)))
+    return xp.concatenate(parts)
 
 
-def unpack_chunk(buf, n_slots: int, chunk_len: int) -> ChunkResult:
+def unpack_chunk(buf, n_slots: int, chunk_len: int,
+                 spec: bool = False) -> ChunkResult:
     """Inverse of ``pack_chunk`` (always numpy — this is the host side)."""
     buf = np.asarray(buf)
-    want = packed_chunk_size(n_slots, chunk_len)
+    want = packed_chunk_size(n_slots, chunk_len, spec=spec)
     if buf.shape != (want,):
         raise ValueError(
             f"packed chunk buffer has shape {buf.shape}, expected ({want},) "
-            f"for n_slots={n_slots} chunk_len={chunk_len}")
+            f"for n_slots={n_slots} chunk_len={chunk_len} spec={spec}")
     nt = n_slots * chunk_len
+    drafted = accepted = None
+    if spec:
+        drafted = buf[nt + 3 * n_slots:nt + 4 * n_slots].astype(np.int32)
+        accepted = buf[nt + 4 * n_slots:nt + 5 * n_slots].astype(np.int32)
     return ChunkResult(
         tokens=buf[:nt].reshape(n_slots, chunk_len),
         done=buf[nt:nt + n_slots].astype(bool),
         lengths=buf[nt + n_slots:nt + 2 * n_slots].astype(np.int32),
         health=buf[nt + 2 * n_slots:nt + 3 * n_slots].astype(np.int32),
         n_alive=int(buf[-1]),
+        drafted=drafted,
+        accepted=accepted,
     )
 
 

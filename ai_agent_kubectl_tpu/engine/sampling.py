@@ -221,7 +221,15 @@ def sample_tokens_seeded(
     Same top-k/top-p filtering as ``sample_tokens_batched`` (the shared
     ``_sample_rows`` scaffold, each row through ``_sample_filtered``);
     only the key derivation differs — per-row independent streams
-    instead of one shared key per step."""
+    instead of one shared key per step.
+
+    Speculative decoding (ISSUE 12) runs this SAME function once per
+    verify position, with ``ngen`` advanced by the accepted-count so
+    far: the token at generation index ``g`` always draws
+    ``fold_in(seed, g)`` from the target's own logits whether it was
+    reached by plain decode, by accepting a draft, or by resampling at
+    the first rejection — which is exactly why a spec-on transcript is
+    byte-identical to spec-off at any draft depth (k=0 included)."""
 
     def _draw(scaled):
         return jax.vmap(
@@ -230,6 +238,25 @@ def sample_tokens_seeded(
 
     with jax.named_scope("sampling"):
         return _sample_rows(logits, temperatures, active, _draw, mask=mask)
+
+
+def greedy_tokens(logits: jnp.ndarray,
+                  mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """[batch, vocab] → [batch] argmax ids, optionally restricted to a
+    grammar-legality ``mask`` (illegal → -inf first).
+
+    This is the DRAFT side of speculative decoding (ISSUE 12): draft
+    proposals are verified by exact match against the target's own
+    seeded sample, so the draft never needs randomness — greedy argmax
+    maximizes the acceptance rate at temperature 0 (where the target is
+    argmax too) and costs no PRNG stream bookkeeping at any
+    temperature. Masking drafts by the same grammar tables the verifier
+    uses keeps proposals legal, so a draft can never waste its verify
+    lane on a token the mask would have zeroed anyway."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    with jax.named_scope("draft_sampling"):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
 def eos_mask(tokens: jnp.ndarray, eos_ids) -> jnp.ndarray:

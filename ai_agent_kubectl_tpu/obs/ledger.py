@@ -34,6 +34,10 @@ construction):
 - ``quarantine_burn`` — tokens generated for a request that was then
                         terminally quarantined (its transcript is
                         discarded, never delivered)
+- ``draft_rejected``  — speculative-decode draft proposals the 7B
+                        verifier rejected (the 2B's step bought nothing;
+                        the acceptance *rate* this implies is the
+                        first-class /metrics signal of ISSUE 12)
 
 Aggregation is per *lane* (the closed three-lane QoS set) for metrics,
 and per *tenant* only in the ``/debug/ledger`` snapshot — tenants must
@@ -61,9 +65,15 @@ CLASS_PREEMPTED = "preempted"
 CLASS_HEDGE_LOSER = "hedge_loser"
 CLASS_WASTED_MASKED = "wasted_masked"
 CLASS_QUARANTINE_BURN = "quarantine_burn"
+#: speculative decoding (ISSUE 12): draft-model proposals the verifier
+#: rejected — the draft engine burned a step deriving a token the 7B
+#: then re-sampled differently, so the work produced no client byte.
+#: (Accepted drafts are the opposite: a transcript token that did NOT
+#: cost its own target forward — they bill delivered like any other.)
+CLASS_DRAFT_REJECTED = "draft_rejected"
 LEDGER_CLASSES = (CLASS_DELIVERED, CLASS_REPLAYED, CLASS_PREEMPTED,
                   CLASS_HEDGE_LOSER, CLASS_WASTED_MASKED,
-                  CLASS_QUARANTINE_BURN)
+                  CLASS_QUARANTINE_BURN, CLASS_DRAFT_REJECTED)
 WASTE_CLASSES = LEDGER_CLASSES[1:]
 
 #: tenant-table overflow bucket: past ``max_tenants`` distinct keys, new

@@ -957,6 +957,12 @@ async def handle_health(request: web.Request) -> web.Response:
     gh = getattr(svc.engine, "grammar_health", None)
     if callable(gh):
         grammar = gh() or None
+    # Speculative decoding (ISSUE 12): draft model id, k, acceptance
+    # rate, degradation state — cheap host counters, same rule.
+    spec = None
+    sph = getattr(svc.engine, "spec_health", None)
+    if callable(sph):
+        spec = sph() or None
     body = HealthResponse(
         status="healthy" if ready and breaker == "closed" else "degraded",
         engine=getattr(svc.engine, "name", "unknown"),
@@ -972,6 +978,7 @@ async def handle_health(request: web.Request) -> web.Response:
         slo=slo,
         kv_pool=kv_pool,
         grammar=grammar,
+        spec=spec,
     )
     # The HTTP status tracks engine readiness alone: an open breaker with
     # the engine process alive still serves (fallback and/or cache), and
@@ -1168,6 +1175,10 @@ async def handle_metrics(request: web.Request) -> web.Response:
         # + dead-end counters — same delta-mirror pattern.
         if stats.get("grammar"):
             svc.metrics.observe_grammar(stats["grammar"])
+        # Speculative decoding (ISSUE 12): drafted/accepted counters +
+        # the acceptance-ratio gauge — same delta-mirror pattern.
+        if stats.get("spec"):
+            svc.metrics.observe_spec(stats["spec"])
     # Windowed throughput gauge: the batcher's own scheduler-side window
     # when it reports one (counts every finish, including streams), else
     # the service-side window fed by the response handlers.

@@ -478,6 +478,31 @@ class Metrics:
         )
         self._grammar_seen = {"forced": 0, "masked": 0, "dead": {}}
 
+        # Speculative decoding (ISSUE 12, engine/batcher.py): draft
+        # proposals vs verifier acceptances, and the derived acceptance
+        # ratio — the first-class signal of whether the 2B is actually
+        # buying the 7B extra tokens per weight read. Delta-mirrored
+        # from stats()["spec"] like the grammar totals; the ratio is a
+        # gauge set from the cumulative counters at scrape time.
+        self.spec_drafted_tokens = Counter(
+            "spec_drafted_tokens_total",
+            "Draft-model token proposals submitted to the verifier",
+            registry=r,
+        )
+        self.spec_accepted_tokens = Counter(
+            "spec_accepted_tokens_total",
+            "Draft proposals the target model's verify step accepted "
+            "(each one is a transcript token that cost no extra "
+            "target forward)",
+            registry=r,
+        )
+        self.spec_acceptance_ratio = Gauge(
+            "spec_acceptance_ratio",
+            "Cumulative accepted/drafted ratio of speculative decoding",
+            registry=r,
+        )
+        self._spec_seen = {"drafted": 0, "accepted": 0}
+
         # Request-lifecycle phase attribution (obs/trace.py): where a
         # request's wall time went. The ``phase`` label is drawn from the
         # fixed obs.PHASES allowlist — cardinality is bounded by
@@ -650,6 +675,25 @@ class Metrics:
                 self.grammar_dead_ends.labels(cause=cause).inc(
                     total - prev)
                 seen["dead"][cause] = total
+
+    def observe_spec(self, spec: dict) -> None:
+        """Delta-mirror the engine's speculative-decode totals
+        (stats()["spec"]) into Prometheus at scrape time — counters
+        delta-inc'd like the grammar mirror, the acceptance ratio set
+        as a gauge from the cumulative totals."""
+        seen = self._spec_seen
+        for key, counter, total in (
+                ("drafted", self.spec_drafted_tokens,
+                 spec.get("drafted_tokens_total", 0)),
+                ("accepted", self.spec_accepted_tokens,
+                 spec.get("accepted_tokens_total", 0))):
+            if total > seen[key]:
+                counter.inc(total - seen[key])
+                seen[key] = total
+        drafted = spec.get("drafted_tokens_total", 0)
+        if drafted:
+            self.spec_acceptance_ratio.set(
+                spec.get("accepted_tokens_total", 0) / drafted)
 
     def observe_slo(self, slo: dict) -> None:
         """Mirror the SLO burn snapshot (stats()["slo"]) into

@@ -116,3 +116,8 @@ class HealthResponse(BaseModel):
     # masked token totals, and dead ends by cause. None = GRAMMAR_DECODE
     # off or an engine without the subsystem.
     grammar: Optional[Dict[str, Any]] = None
+    # Speculative decoding (ISSUE 12, engine/batcher.py): draft model
+    # id, k, live/degraded state, drafted/accepted totals and the
+    # acceptance ratio. None = SPEC_DECODE off or an engine without the
+    # subsystem.
+    spec: Optional[Dict[str, Any]] = None

@@ -22,6 +22,11 @@ fault points that the engine layer checks at its seams:
   lane) ahead of the next real submission, so the QoS ring's fair-share
   admission and preemptive decode (ISSUE 7) are exercisable without a
   load generator
+- ``draft`` — ``draft:die`` kills the speculative-decode DRAFT engine
+  (ISSUE 12): one-shot, checked at chunk dispatch — the engine must
+  degrade to plain (non-speculative) decode without failing a single
+  in-flight request, which is exactly what exact-match verification
+  guarantees (the transcript never depended on the drafts)
 - ``generate`` — the whole engine call (applied by ``ChaosEngine``, the
   protocol wrapper the factory installs when FAULT_POINTS names it)
 
@@ -66,14 +71,15 @@ _MODES = ("error", "delay", "hang", "nan", "poison_step", "die", "flood")
 #: the closed set of check sites; a typo'd point in FAULT_POINTS must be
 #: a startup error, not a silently inert game-day drill.
 KNOWN_POINTS = ("admit", "chunk", "decode", "scheduler", "tenant",
-                "generate")
+                "draft", "generate")
 
 #: (point, mode) pairs that only make sense together — a drill spec
 #: arming e.g. ``admit:nan`` is a typo, not chaos.
 _POINT_ONLY_MODES = {"nan": ("decode",), "poison_step": ("decode",),
-                     "die": ("scheduler",), "flood": ("tenant",)}
+                     "die": ("scheduler", "draft"), "flood": ("tenant",)}
 _RESTRICTED_POINTS = {"decode": ("nan", "poison_step"),
-                      "scheduler": ("die",), "tenant": ("flood",)}
+                      "scheduler": ("die",), "tenant": ("flood",),
+                      "draft": ("die",)}
 
 #: tenant key + lane the flood drill's synthetic burst runs under —
 #: fixed so fairness assertions and dashboards can name the flooder.
@@ -372,6 +378,22 @@ class FaultInjector:
         self._fired["tenant"] = self._fired.get("tenant", 0) + 1
         return int(fault.arg)
 
+    def draft_die(self, replica: Optional[int] = None) -> bool:
+        """``draft:die`` — one-shot: returns True exactly once, telling
+        the engine its draft model just died. Never raises — the whole
+        point of the drill is that losing the draft engine is NOT an
+        error path: the scheduler flips to plain decode mid-stream and
+        every in-flight request finishes byte-identically (exact-match
+        verification means no transcript ever depended on a draft)."""
+        fault = self._faults.get("draft")
+        if fault is None or fault.mode != "die":
+            return False
+        if not self._in_scope(fault, replica):
+            return False
+        del self._faults["draft"]
+        self._fired["draft"] = self._fired.get("draft", 0) + 1
+        return True
+
     def check_scheduler_die(self, replica: Optional[int] = None) -> None:
         """``scheduler:die`` — one-shot: raises ``SchedulerKilled`` (a
         BaseException) so the scheduler loop genuinely dies; disarms
@@ -442,6 +464,9 @@ class ReplicaFaults:
     def check_scheduler_die(self) -> None:
         self.inner.check_scheduler_die(replica=self.replica)
 
+    def draft_die(self) -> bool:
+        return self.inner.draft_die(replica=self.replica)
+
     def tenant_flood(self) -> int:
         return self.inner.tenant_flood(replica=self.replica)
 
@@ -495,6 +520,11 @@ class ChaosEngine:
     def slo_health(self) -> dict:
         """Forward the SLO burn-rate /health section (ISSUE 8)."""
         fn = getattr(self.inner, "slo_health", None)
+        return fn() if callable(fn) else {}
+
+    def spec_health(self) -> dict:
+        """Forward the speculative-decode /health section (ISSUE 12)."""
+        fn = getattr(self.inner, "spec_health", None)
         return fn() if callable(fn) else {}
 
     def ledger_snapshot(self) -> dict:

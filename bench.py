@@ -395,6 +395,82 @@ async def phase_grammar7b(batch_size: int, max_seq: int, kv_quant: str,
     }
 
 
+async def phase_spec7b(batch_size: int, max_seq: int, kv_quant: str,
+                       spec: bool, spec_k: int, grammar: bool,
+                       chunk_len: int = 16) -> dict:
+    """One rung of the ISSUE 12 speculative-decode sweep: the kubectl
+    query set decoded greedily with SPEC_DECODE off vs on over
+    k ∈ {2,4,8} at the bs=48 geometry, recording tok/s AND the measured
+    acceptance rate (the artifact must carry both — spec throughput is
+    meaningless without the acceptance that produced it). The combined
+    ``--grammar on`` rung measures the stacking with forced runs:
+    forced tokens ride prefills (no drafting at all), masked sampled
+    tokens draft/verify, and the two wins multiply. Checkpoints: set
+    MODEL_PATH (7B) and SPEC_DRAFT_PATH (2B) for real-weight
+    acceptance; random-init rungs still measure the verify-window
+    mechanics honestly but accept near-nothing."""
+    import jax
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "not on TPU"}
+
+    cfg7 = get_config("gemma-7b-it")
+    tok7, _ = make_tokenizer(cfg7)
+    log(f"bench: spec7b rung bs={batch_size} spec={spec} k={spec_k} "
+        f"grammar={grammar}")
+    eng = BatchedJaxEngine(
+        cfg7,
+        tokenizer=tok7,
+        dtype="bfloat16",
+        quant="int8",
+        kv_quant=kv_quant,
+        max_seq_len=max_seq,
+        prefill_buckets=(64, 128),
+        batch_size=batch_size,
+        chunk_len=chunk_len,
+        model_path=os.environ.get("MODEL_PATH") or None,
+        grammar_decode=grammar,
+        spec_decode=spec,
+        spec_draft_k=spec_k,
+        spec_draft_model="gemma-2b-it",
+        spec_draft_path=os.environ.get("SPEC_DRAFT_PATH") or None,
+    )
+    t0 = time.monotonic()
+    await eng.start()
+    log(f"bench: spec7b engine ready in {time.monotonic() - t0:.1f}s")
+    prompts = [render_prompt(q) for q in GRAMMAR_QUERIES]
+    n_tokens = 0
+    t0 = time.monotonic()
+    for _ in range(2):
+        results = await asyncio.gather(*[
+            eng.generate(p, max_tokens=48, temperature=0.0)
+            for p in prompts])
+        n_tokens += sum(r.completion_tokens for r in results)
+    wall = time.monotonic() - t0
+    sh = eng.spec_health() or {}
+    gh = (eng.grammar_health() or {}) if grammar else {}
+    await eng.stop()
+    return {
+        "model": "gemma-7b-it",
+        "batch_size": batch_size,
+        "kv_quant": kv_quant,
+        "spec": spec,
+        "spec_k": spec_k,
+        "grammar": grammar,
+        "completion_tokens": n_tokens,
+        "drafted_tokens_total": sh.get("drafted_tokens_total", 0),
+        "accepted_tokens_total": sh.get("accepted_tokens_total", 0),
+        "acceptance_ratio": sh.get("acceptance_ratio"),
+        "forced_tokens_total": gh.get("forced_tokens_total", 0),
+        "tokens_per_sec_per_chip": round(
+            n_tokens / wall / len(jax.devices()), 2),
+    }
+
+
 async def phase_pipe7b(batch_size: int, max_seq: int, kv_quant: str,
                        pipe_depth: int, chunk_len: int = 16) -> dict:
     """One rung of the CHUNK_PIPE_DEPTH sweep (ISSUE 4): serving
@@ -865,6 +941,42 @@ def orchestrate() -> dict:
         if gram_sweep:
             extra7["grammar_sweep"] = gram_sweep
 
+        # Speculative-decode sweep (ISSUE 12): off rung + on rungs over
+        # k ∈ {2,4,8} at bs=48 (tok/s must be read against the measured
+        # acceptance rate riding the same artifact), plus the grammar+
+        # spec combined rung measuring the forced-run stacking.
+        spec_sweep: dict = {}
+        spec_keys = ("tokens_per_sec_per_chip", "acceptance_ratio",
+                     "drafted_tokens_total", "accepted_tokens_total",
+                     "completion_tokens", "forced_tokens_total")
+        rs = _run_phase(
+            ["--phase", "spec7b", "--bs", "48",
+             "--max-seq", str(extra7["max_seq_len"]),
+             "--kv-quant", extra7["kv_quant"], "--spec", "off"],
+            timeout=1800)
+        if rs is not None and "skipped" not in rs:
+            spec_sweep["off"] = {k: rs.get(k) for k in spec_keys}
+        for k in (2, 4, 8):
+            rs = _run_phase(
+                ["--phase", "spec7b", "--bs", "48",
+                 "--max-seq", str(extra7["max_seq_len"]),
+                 "--kv-quant", extra7["kv_quant"],
+                 "--spec", "on", "--spec-k", str(k)],
+                timeout=1800)
+            if rs is not None and "skipped" not in rs:
+                spec_sweep[f"k{k}"] = {kk: rs.get(kk)
+                                       for kk in spec_keys}
+        rs = _run_phase(
+            ["--phase", "spec7b", "--bs", "48",
+             "--max-seq", str(extra7["max_seq_len"]),
+             "--kv-quant", extra7["kv_quant"],
+             "--spec", "on", "--spec-k", "4", "--grammar", "on"],
+            timeout=1800)
+        if rs is not None and "skipped" not in rs:
+            spec_sweep["k4_grammar"] = {k: rs.get(k) for k in spec_keys}
+        if spec_sweep:
+            extra7["spec_sweep"] = spec_sweep
+
     rmoe = _run_phase(["--phase", "moe"], timeout=2400)
 
     r2 = _run_phase(["--phase", "2b"], timeout=2400)
@@ -896,7 +1008,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", choices=["7b", "2b", "moe", "attr7b",
                                         "pipe7b", "paged7b",
-                                        "grammar7b"],
+                                        "grammar7b", "spec7b"],
                     default=None)
     ap.add_argument("--bs", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=256)
@@ -907,6 +1019,8 @@ def main() -> None:
     ap.add_argument("--pool-envelope-bs", type=int, default=0)
     ap.add_argument("--agent-loop", action="store_true")
     ap.add_argument("--grammar", choices=["on", "off"], default="off")
+    ap.add_argument("--spec", choices=["on", "off"], default="off")
+    ap.add_argument("--spec-k", type=int, default=4)
     ns = ap.parse_args()
 
     if ns.phase == "7b":
@@ -925,6 +1039,11 @@ def main() -> None:
         result = asyncio.run(
             phase_grammar7b(ns.bs, ns.max_seq, ns.kv_quant,
                             ns.grammar == "on", ns.chunk_len))
+    elif ns.phase == "spec7b":
+        result = asyncio.run(
+            phase_spec7b(ns.bs, ns.max_seq, ns.kv_quant,
+                         ns.spec == "on", ns.spec_k,
+                         ns.grammar == "on", ns.chunk_len))
     elif ns.phase == "attr7b":
         result = phase_attr7b(ns.bs, ns.max_seq, ns.kv_quant)
     elif ns.phase == "2b":

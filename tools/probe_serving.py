@@ -136,6 +136,7 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
     print_fleet_summary(gauges)
     print_qos_summary(gauges)
     print_goodput_summary(gauges)
+    print_spec_summary(gauges)
     print_slo_summary(gauges)
 
 
@@ -299,7 +300,7 @@ def _parse_labels(labelstr: str) -> Dict[str, str]:
 
 #: goodput table column order — delivered first, then the waste classes.
 _LEDGER_CLASSES = ("delivered", "replayed", "preempted", "hedge_loser",
-                   "wasted_masked", "quarantine_burn")
+                   "wasted_masked", "quarantine_burn", "draft_rejected")
 
 
 def print_goodput_summary(gauges: Dict[str, float]) -> None:
@@ -325,6 +326,24 @@ def print_goodput_summary(gauges: Dict[str, float]) -> None:
         log("  " + f"{lane:<12}" + "".join(
             f"{row.get(cls, 0.0):>16.0f}" for cls in _LEDGER_CLASSES)
             + f"{pct:>9.1f}%")
+
+
+def print_spec_summary(gauges: Dict[str, float]) -> None:
+    """Speculative decoding (ISSUE 12) from the same /metrics scrape:
+    the acceptance table next to the goodput table — drafted vs
+    accepted proposals and the cumulative acceptance ratio (how many
+    transcript tokens each 7B weight read is actually buying)."""
+    drafted = gauges.get("spec_drafted_tokens_total")
+    if drafted is None:
+        return      # SPEC_DECODE off / engine without the subsystem
+    accepted = gauges.get("spec_accepted_tokens_total", 0.0)
+    ratio = gauges.get("spec_acceptance_ratio",
+                       accepted / drafted if drafted else 0.0)
+    log("probe[spec]: speculative decoding acceptance")
+    log(f"  {'drafted':>12} {'accepted':>12} {'rejected':>12} "
+        f"{'acceptance':>12}")
+    log(f"  {drafted:>12.0f} {accepted:>12.0f} "
+        f"{drafted - accepted:>12.0f} {ratio:>11.1%}")
 
 
 def print_slo_summary(gauges: Dict[str, float]) -> None:
