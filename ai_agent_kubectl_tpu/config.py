@@ -303,6 +303,22 @@ class ServiceConfig:
     # only (drain/eject leaves the replica down until an operator acts).
     fleet_rejoin_secs: float = 0.0          # FLEET_REJOIN_SECS
 
+    # --- zero-downtime weight rollout (ISSUE 13; engine/rollout.py) ---
+    # Fraction of FRESH traffic the router steers at the canary replica
+    # while a rollout observes it. Clamped to (0, 0.5] at boot — the
+    # canary must never be able to starve the stable cohort's
+    # interactive lane.
+    rollout_canary_share: float = 0.1       # ROLLOUT_CANARY_SHARE
+    # How long the canary serves its bounded share before the promotion
+    # gate's verdict: canary-vs-stable on SLO burn (fast window),
+    # goodput ratio, quarantine/grammar-dead-end counters, breaker.
+    rollout_observe_secs: float = 60.0      # ROLLOUT_OBSERVE_SECS
+    # Burn-gate factor: the canary rolls back when its fast-window burn
+    # reaches this multiple of max(1.0, the stable cohort's burn) — a
+    # fleet already burning from ambient load must not auto-roll a
+    # canary back for matching it. >= 1.
+    rollout_burn_gate: float = 2.0          # ROLLOUT_BURN_GATE
+
     # --- QoS ring (ISSUE 7; engine/qos.py) ---
     # Tenant tiers: "tenantKey:lane,..." mapping a tenant key (the API
     # key a client presents, else its client IP) to the HIGHEST lane it
@@ -491,6 +507,23 @@ class ServiceConfig:
             from .constrain import assert_safety_consistent
 
             assert_safety_consistent()
+        # Weight-rollout knobs (ISSUE 13): a canary share outside
+        # (0, 0.5] either disables the observe phase silently or lets
+        # the canary starve the stable cohort — both refuse to boot.
+        if not 0.0 < self.rollout_canary_share <= 0.5:
+            raise ValueError(
+                f"ROLLOUT_CANARY_SHARE must be in (0, 0.5] (the canary "
+                f"may never take more fresh traffic than the stable "
+                f"cohort), got {self.rollout_canary_share}")
+        if self.rollout_observe_secs < 0:
+            raise ValueError(
+                f"ROLLOUT_OBSERVE_SECS must be >= 0, "
+                f"got {self.rollout_observe_secs}")
+        if self.rollout_burn_gate < 1.0:
+            raise ValueError(
+                f"ROLLOUT_BURN_GATE must be >= 1 (a factor below the "
+                f"sustainable burn rate would roll back every healthy "
+                f"canary), got {self.rollout_burn_gate}")
         # Speculative-decode knobs (ISSUE 12): an impossible combination
         # or an unknown/mismatched draft model must refuse to boot, not
         # silently serve plain decode behind a knob that says otherwise.
@@ -616,6 +649,9 @@ class ServiceConfig:
             fleet_affinity=_env_bool("FLEET_AFFINITY", True),
             fleet_migration_budget=_env_int("FLEET_MIGRATION_BUDGET", 3),
             fleet_rejoin_secs=_env_float("FLEET_REJOIN_SECS", 0.0),
+            rollout_canary_share=_env_float("ROLLOUT_CANARY_SHARE", 0.1),
+            rollout_observe_secs=_env_float("ROLLOUT_OBSERVE_SECS", 60.0),
+            rollout_burn_gate=_env_float("ROLLOUT_BURN_GATE", 2.0),
             tenant_tiers=_env_str("TENANT_TIERS", "") or "",
             qos_default_lane=(
                 _env_str("QOS_DEFAULT_LANE", "interactive")

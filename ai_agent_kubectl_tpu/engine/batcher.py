@@ -1064,11 +1064,14 @@ class BatchedJaxEngine(JaxEngine):
             logger.warning(
                 "KV_POOL does not compose with a serving mesh yet; "
                 "falling back to the dense KV ladder")
-        if self.grammar_decode:
+        if self.grammar_decode and self._grammar is None:
             # Grammar runtime (ISSUE 11): compile the kubectl grammar
             # against THIS tokenizer. Host numpy truth; the stacked
             # fixed-shape tables upload to device at dispatch time
             # (refreshed whenever a per-request variant installs).
+            # Kept across stop() → start() restarts (weight swaps don't
+            # change the tokenizer, and the compile costs seconds at a
+            # real vocab).
             from ..constrain import GrammarRuntime, assert_safety_consistent
 
             assert_safety_consistent()
@@ -1106,7 +1109,13 @@ class BatchedJaxEngine(JaxEngine):
                     f"{self.model_cfg.vocab_size} — draft and verifier "
                     f"must share one tokenizer")
             self._draft_cfg = draft_cfg
-            if self.spec_draft_path:
+            if self._draft_params is not None:
+                # Restart (weight swap / fleet rejoin): the draft's
+                # PARAMS survive — a rollout swaps the target weights —
+                # while its KV world rebuilds in _init_decode_state like
+                # a containment reset.
+                pass
+            elif self.spec_draft_path:
                 from ..models.convert import convert_hf_checkpoint
                 logger.info("Loading draft checkpoint from %s",
                             self.spec_draft_path)
@@ -1417,10 +1426,16 @@ class BatchedJaxEngine(JaxEngine):
         donate = (1, 2, 3, 7, 8)
         if self._grammar is not None:
             donate = donate + ((12,) if self._use_pool else (11,))
-        self._batch_chunk_fns = {
-            b: jax.jit(chunk_body(b), donate_argnums=donate)
-            for b in self._kv_buckets
-        }
+        if not getattr(self, "_batch_chunk_fns", None):
+            # First start only: stop() → start() restarts (weight
+            # swaps, fleet rejoins) reuse the jitted program set —
+            # params are a traced argument of unchanged shape, so a
+            # swapped replica's first request re-executes warm programs
+            # instead of paying a multi-second re-trace + compile.
+            self._batch_chunk_fns = {
+                b: jax.jit(chunk_body(b), donate_argnums=donate)
+                for b in self._kv_buckets
+            }
 
         if self._use_spec:
             # Speculative draft/verify chunk programs (ISSUE 12), one
@@ -1459,10 +1474,11 @@ class BatchedJaxEngine(JaxEngine):
             sdonate = (1, 2, 3, 7, 8, 13)
             if self._grammar is not None:
                 sdonate = sdonate + (14,)
-            self._spec_chunk_fns = {
-                b: jax.jit(spec_chunk_body(b), donate_argnums=sdonate)
-                for b in self._kv_buckets
-            }
+            if not self._spec_chunk_fns:   # restarts keep the programs
+                self._spec_chunk_fns = {
+                    b: jax.jit(spec_chunk_body(b), donate_argnums=sdonate)
+                    for b in self._kv_buckets
+                }
 
         def splice(cache, src_k, src_v, tok, pos, temps, active, ngen,
                    budget, seeds, slot, n_prompt, first_tok, temperature,
@@ -1492,10 +1508,12 @@ class BatchedJaxEngine(JaxEngine):
             return (KVCache(k=k, v=v, lengths=lengths), tok, pos, temps,
                     active, ngen, budget, seeds)
 
-        self._splice_fn = jax.jit(splice,
-                                  donate_argnums=(0, 3, 4, 5, 6, 7, 8, 9))
-        self._batch_admit_fns = {}   # (kind, *shape) -> jitted program
-        self._batch_ready = set()    # (kpad, sbucket, kv_limit) compiled
+        if getattr(self, "_splice_fn", None) is None:
+            self._splice_fn = jax.jit(
+                splice, donate_argnums=(0, 3, 4, 5, 6, 7, 8, 9))
+        if not hasattr(self, "_batch_admit_fns"):
+            self._batch_admit_fns = {}  # (kind, *shape) -> jitted program
+            self._batch_ready = set()   # (kpad, sbucket, kv_limit) compiled
         self._S_alloc = S_alloc
 
         # Device-side scheduler state (slot vectors + KV cache) — built
@@ -4798,6 +4816,7 @@ class BatchedJaxEngine(JaxEngine):
             prefix_cache_hit=slot.prefix_hit,
             finish_reason=finish,
             engine=self.name,
+            weights_version=self.weights_version,
         )
         self._emit(slot.req, "done", result)
 
@@ -4913,6 +4932,11 @@ class BatchedJaxEngine(JaxEngine):
             ttft_exempt=bool(resume_ids),
             gpid=gpid,
         )
+        if export is not None:
+            # Version the portable state at submit: ids this engine
+            # generates are a function of THESE weights, and the fleet's
+            # version-pinned failover routes on this stamp (ISSUE 13).
+            export.weights_version = self.weights_version
         # Fair-share load shedding at submit time (QoSQueue policy):
         # past the per-tenant cap → 429 to the flooding tenant; past
         # MAX_QUEUE_DEPTH → displace the dominant tenant's newest

@@ -58,6 +58,9 @@ class FakeEngine:
     """
 
     name = "fake"
+    #: rule-table "weights" never change — one constant version keeps
+    #: /health and X-Model-Version uniform across engine kinds.
+    weights_version = "fake-rules-0"
 
     def __init__(self, delay: float = 0.0):
         self.delay = delay
@@ -251,9 +254,24 @@ class FakeChunkedEngine:
                  spec_fake_miss: int = 3,
                  max_seq_len: int = 256,
                  faults=None,
+                 weights_version: str = "fake-0",
                  stream_fn: Optional[Callable[[str], List[int]]] = None):
         if chunk_pipe_depth < 1:
             raise ValueError("chunk_pipe_depth must be >= 1")
+        # Weight rollout (ISSUE 13): the fake's "weights" are the
+        # keystream its scripted tokens derive from — _default_stream
+        # folds the version in (the default keeps historical streams
+        # byte-identical), so a version swap genuinely changes outputs
+        # while two same-version replicas stay byte-identical, exactly
+        # the property the fleet's version-pinned failover rests on.
+        self.weights_version = str(weights_version)
+        # A restorable "checkpoint" from the first breath: a rollback
+        # must have something to swap back TO even for an engine that
+        # never loaded from disk (swap_weights honours the version
+        # override, so restoring this sentinel restores version and
+        # therefore the exact byte streams).
+        self.checkpoint_path: Optional[str] = (
+            f"fake:initial:{self.weights_version}")
         self.batch_size = batch_size
         self.chunk_len = chunk_len
         self.chunk_pipe_depth = chunk_pipe_depth
@@ -629,8 +647,14 @@ class FakeChunkedEngine:
 
     def _default_stream(self, prompt: str) -> List[int]:
         """Deterministic ragged stream: 3-25 tokens drawn from a crc32
-        keystream (values kept clear of the EOS ids), EOS-terminated."""
-        h = zlib.crc32(prompt.encode())
+        keystream (values kept clear of the EOS ids), EOS-terminated.
+        The keystream is keyed on (weights version, prompt) — swapped
+        "weights" really do change the transcript — with the default
+        version keeping the historical prompt-only keying so every
+        pre-rollout byte expectation holds verbatim."""
+        key = (prompt if self.weights_version == "fake-0"
+               else f"{self.weights_version}|{prompt}")
+        h = zlib.crc32(key.encode())
         n = 3 + h % 23
         lo = max(self.eos_ids) + 1
         return [lo + ((h >> (i % 24)) + 7 * i) % 211
@@ -689,6 +713,47 @@ class FakeChunkedEngine:
             req.out_queue.put_nowait(
                 ("error", EngineUnavailable("engine stopped")))
         self._inflight.clear()
+
+    def swap_weights(self, path: str, *, version: Optional[str] = None
+                     ) -> str:
+        """Weight-swap mirror (ISSUE 13) of the batcher's: requires a
+        stopped (drained) engine, is atomic under the
+        ``checkpoint:corrupt`` drill (the prior version stays armed),
+        dies attributably under ``swap:fail``, and rebuilds the KV-pool
+        world exactly like a containment reset — so the rollout state
+        machine, version-pinned failover, and rollback books are all
+        testable in tier-1 milliseconds."""
+        from .rollout import (CheckpointCorrupt, RolloutError, SwapFailed,
+                              checkpoint_version)
+
+        if self._ready:
+            raise RolloutError(
+                "swap_weights requires a stopped (drained) engine")
+        version = version or checkpoint_version(path)
+        if self.faults is not None \
+                and hasattr(self.faults, "checkpoint_corrupt") \
+                and self.faults.checkpoint_corrupt():
+            raise CheckpointCorrupt(
+                f"checkpoint {path!r} failed integrity validation "
+                f"(injected checkpoint:corrupt drill)")
+        if self.faults is not None \
+                and hasattr(self.faults, "swap_fail") \
+                and self.faults.swap_fail():
+            # Mid-swap death: the old "weights" are gone — serving this
+            # replica again without a successful re-swap would serve
+            # unknown bytes, so it stays down (cause swap_failed) and
+            # both stamps clear together (batcher mirror).
+            self.weights_version = ""
+            self.checkpoint_path = None
+            raise SwapFailed(
+                "injected swap:fail — replica died mid-swap")
+        self.weights_version = version
+        self.checkpoint_path = str(path)
+        if self._pool is not None:
+            # New weights invalidate every cached block's (fictional)
+            # KV — the ownership world restarts empty, like a reset.
+            self._pool_reset()
+        return version
 
     def set_reset_listener(self, fn) -> None:
         """Wire engine resets to the service layer (the PR 1 breaker) —
@@ -1672,6 +1737,7 @@ class FakeChunkedEngine:
             completion_tokens=len(ids),
             finish_reason=finish,
             engine=self.name,
+            weights_version=self.weights_version,
         )
 
     async def stream_events(self, prompt: str, *, max_tokens: int = 128,
@@ -1745,6 +1811,10 @@ class FakeChunkedEngine:
             ttft_exempt=bool(resume_ids),
             gpid=gpid,
         )
+        if export is not None:
+            # Version the portable state at submit (ISSUE 13): the
+            # fleet's version-pinned failover routes on this stamp.
+            export.weights_version = self.weights_version
         # put() raises TenantOverloaded (429) at the per-tenant cap and
         # EngineOverloaded when this tenant floods a full queue; a quiet
         # arrival instead displaces the flooder's newest request.

@@ -166,7 +166,14 @@ class _Replica:
         self.eject_cause: Optional[str] = None
         self.last_error: str = ""
         self.migrations_out = 0      # requests migrated OFF this replica
+        self.dispatches = 0          # cumulative fleet dispatches landed
         self.not_ready_since: Optional[float] = None
+
+    def weights_version(self) -> str:
+        """The checkpoint version this replica's engine serves (ISSUE
+        13) — the pin key for migration/hedge/replay routing and the
+        per-replica /health stamp. "" for engines without versioning."""
+        return str(getattr(self.engine, "weights_version", "") or "")
 
     def occupancy(self) -> int:
         """Cheap slot occupancy (never calls stats() — stats drains the
@@ -239,6 +246,15 @@ class EngineFleet:
         ]
         self.affinity: Optional[PrefixAffinity] = (
             PrefixAffinity() if affinity else None)
+        # Weight rollout (ISSUE 13): while a canary is set, the router
+        # steers a bounded fraction of FRESH traffic at it via a share
+        # accumulator (exact over any request count, no RNG); while a
+        # swap is in flight (swap_hint > 0) a no-replica moment sheds
+        # with a priced 503 instead of a bare EngineUnavailable.
+        self._canary_idx: Optional[int] = None
+        self._canary_share = 0.0
+        self._canary_acc = 0.0
+        self.swap_hint = 0.0
         self._stopping = False
         self._monitor_task: Optional[asyncio.Task] = None
         self._rejoin_tasks: Set[asyncio.Task] = set()
@@ -432,7 +448,16 @@ class EngineFleet:
                 self.affinity.forget_replica(idx)
         logger.info("fleet: draining replica %d (%d in-flight)",
                     idx, len(rep.flights))
-        if self._routable():
+        # Version-pinned migration (ISSUE 13): a nudged flight can only
+        # re-splice onto a replica serving the SAME weights — so the
+        # nudge targets are same-version siblings, and when none exist
+        # (last replica, or last replica on the outgoing version during
+        # a rollout promote) in-flight work finishes in place instead of
+        # being aborted into unroutable migrations.
+        v = rep.weights_version()
+        targets = [r for r in self._routable()
+                   if not v or r.weights_version() == v]
+        if targets:
             # QoS eviction order: background (and batch) migrate FIRST;
             # interactive flights keep decoding here until the lower
             # lanes have re-seated (or a slice of the budget passes) so
@@ -454,15 +479,16 @@ class EngineFleet:
             for flight in list(rep.flights):
                 flight.migrate.set()
         elif rep.flights:
-            # No healthy migration target (last routable replica being
-            # drained): a nudge would abort every in-flight request into
-            # "no healthy replica" errors. Let them finish in place on
-            # this replica within the drain budget instead — same
-            # finish-in-place semantics as whole-fleet stop().
+            # No same-version migration target (last routable replica,
+            # or the last replica on this weights version): a nudge
+            # would abort every in-flight request into "no healthy
+            # replica" errors. Let them finish in place on this replica
+            # within the drain budget instead — same finish-in-place
+            # semantics as whole-fleet stop().
             logger.warning(
-                "fleet: no migration target while draining replica %d; "
-                "letting %d in-flight requests finish in place",
-                idx, len(rep.flights))
+                "fleet: no same-version migration target while draining "
+                "replica %d; letting %d in-flight requests finish in "
+                "place", idx, len(rep.flights))
         deadline = time.monotonic() + drain_secs
         while rep.flights and time.monotonic() < deadline:
             await asyncio.sleep(0.01)
@@ -500,6 +526,42 @@ class EngineFleet:
         self._rejoins += 1
         logger.info("fleet: replica %d rejoined", idx)
 
+    # -------------------------------------------- weight rollout (ISSUE 13)
+
+    @property
+    def weights_version(self) -> str:
+        """The STABLE version the fleet serves: the most common version
+        among active non-canary replicas (falling back to any replica)
+        — what /health's top level and X-Model-Version echo."""
+        counts: Dict[str, int] = {}
+        for rep in self.replicas:
+            if rep.state == REPLICA_ACTIVE and rep.idx != self._canary_idx:
+                v = rep.weights_version()
+                if v:
+                    counts[v] = counts.get(v, 0) + 1
+        if not counts:
+            for rep in self.replicas:
+                v = rep.weights_version()
+                if v:
+                    counts[v] = counts.get(v, 0) + 1
+        if not counts:
+            return ""
+        return max(sorted(counts), key=lambda v: counts[v])
+
+    def set_canary(self, idx: int, share: float) -> None:
+        """Steer ``share`` of fresh traffic at replica ``idx`` (the
+        rollout controller's observe phase). Clamped to at most half —
+        the canary must never be able to starve the stable cohort's
+        interactive lane."""
+        self._canary_idx = int(idx)
+        self._canary_share = min(max(0.0, float(share)), 0.5)
+        self._canary_acc = 0.0
+
+    def clear_canary(self) -> None:
+        self._canary_idx = None
+        self._canary_share = 0.0
+        self._canary_acc = 0.0
+
     # ------------------------------------------------------------- routing
 
     def _routable(self, exclude: Sequence[int] = ()) -> List[_Replica]:
@@ -512,17 +574,48 @@ class EngineFleet:
         ]
 
     def _route(self, prompt: str, exclude: Sequence[int] = (),
-               lane: Optional[str] = None) -> Optional[_Replica]:
+               lane: Optional[str] = None,
+               version: Optional[str] = None) -> Optional[_Replica]:
         """Health-aware pick: least-loaded among routable replicas,
         overridden by prefix affinity unless the preferred replica is
         more than AFFINITY_SLACK requests busier. With ``lane`` set the
         load keys are lane-aware (QoS ring): only in-flight work at or
         above the request's lane counts, so a replica whose slots are
         all preemptible background work routes like an idle one for
-        interactive traffic."""
+        interactive traffic.
+
+        Weight rollout (ISSUE 13): ``version`` pins the pick to
+        replicas serving exactly that checkpoint — an established
+        stream's re-splice cannot be byte-identical across weights, so
+        a version-mismatched candidate is simply not a candidate (None
+        when no same-version replica is routable; the caller decides
+        what that means). Fresh traffic (``version=None``) is subject
+        to canary steering instead: the share accumulator sends the
+        canary its bounded fraction and keeps the rest on the stable
+        cohort."""
         cands = self._routable(exclude)
         if not cands:
             return None
+        if version is not None:
+            cands = [r for r in cands if r.weights_version() == version]
+            if not cands:
+                return None
+        elif self._canary_idx is not None:
+            canary = next((r for r in cands
+                           if r.idx == self._canary_idx), None)
+            others = [r for r in cands if r.idx != self._canary_idx]
+            if canary is not None and others:
+                self._canary_acc += self._canary_share
+                if self._canary_acc >= 1.0:
+                    self._canary_acc -= 1.0
+                    if self.affinity is not None:
+                        self.affinity.record(prompt, canary.idx)
+                    return canary
+                # Stable traffic stays off the canary — without this the
+                # canary's least-loaded idleness would attract far more
+                # than its bounded share.
+                cands = others
+            # canary-only candidates: availability beats the share bound.
         best = min(cands, key=lambda r: (r.inflight_for(lane),
                                          r.occupancy_for(lane),
                                          r.inflight, r.idx))
@@ -630,10 +723,20 @@ class EngineFleet:
         exclude: List[int] = []
         last_err: Optional[BaseException] = None
         overload_tried: List[int] = []
+        # Weight rollout (ISSUE 13): the checkpoint version that
+        # generated this stream's prefix. An ESTABLISHED stream (any
+        # generated ids or delivered bytes) only routes to same-version
+        # replicas — a cross-version re-splice cannot be byte-identical
+        # — while a fresh request routes freely and, after a failed
+        # fresh dispatch, replays from scratch on whatever version it
+        # lands on (pin re-stamps per attempt).
+        pinned: Optional[str] = None
 
         while True:
+            established = bool(delivered) or bool(export_ids)
+            want = pinned if (pinned and established) else None
             rep = self._route(prompt, exclude=exclude + overload_tried,
-                              lane=flight.lane)
+                              lane=flight.lane, version=want)
             if rep is None:
                 if isinstance(last_err, EngineOverloaded):
                     # Every routable replica shed: propagate, re-priced
@@ -645,8 +748,32 @@ class EngineFleet:
                     raise type(last_err)(
                         str(last_err),
                         retry_after=self.retry_after_hint())
+                if want is not None and self._routable():
+                    # Healthy replicas exist — on OTHER weights. Failing
+                    # here is the version-pinning contract: the client
+                    # keeps the bytes it has; a cross-version splice
+                    # would silently corrupt the transcript. The
+                    # explicit error names the contract (chained on the
+                    # root cause) so operators see "rollout pinning",
+                    # not a bare replica error.
+                    raise EngineUnavailable(
+                        f"no replica serves weights {want} for this "
+                        f"established stream (rollout in progress)"
+                    ) from last_err
+                if self.swap_hint > 0:
+                    # A rollout swap is mid-flight on the only capacity
+                    # (FLEET_SIZE=1 in-place swap): shed with a priced
+                    # Retry-After so the LB re-offers after the warmup.
+                    raise EngineOverloaded(
+                        "no replica available while a weight swap is "
+                        "in flight", retry_after=self.swap_hint)
                 raise last_err or EngineUnavailable(
                     "no healthy replica available")
+            if not established:
+                # Fresh (re-)dispatch: (re-)pin to the replica actually
+                # serving it — a failed fresh attempt on v1 may replay
+                # from scratch on v2 as a fresh request.
+                pinned = rep.weights_version() or None
             remaining = None
             if deadline is not None:
                 remaining = deadline - time.monotonic()
@@ -665,7 +792,8 @@ class EngineFleet:
                     prompt=prompt, max_tokens=max_tokens,
                     temperature=temperature, timeout=remaining, seed=seed,
                     resume_ids=(list(export_ids) if migrations else None),
-                    delivered=delivered):
+                    delivered=delivered,
+                    version=rep.weights_version() or None):
                 kind = item[0]
                 if kind == "token":
                     delivered += item[1]
@@ -675,7 +803,7 @@ class EngineFleet:
             if outcome is None:  # pragma: no cover - defensive
                 outcome, payload = "err", (
                     EngineUnavailable("attempt ended without an outcome"),
-                    [])
+                    [], None)
             if outcome == "done":
                 result = payload[0]
                 rep.breaker.record_success()
@@ -688,9 +816,13 @@ class EngineFleet:
                 return
             if outcome == "migrate":
                 # Voluntary (drain/eject nudge): no breaker failure.
-                err, ids = payload
+                err, ids, ver = payload
                 if len(ids) > len(export_ids):
                     export_ids = ids
+                if export_ids and ver:
+                    # The engine's own export stamp is authoritative
+                    # for which weights generated the carried ids.
+                    pinned = ver
                 migrations = self._count_migration(
                     rep, export_ids, migrations, err)
                 if trace is not None:
@@ -700,7 +832,8 @@ class EngineFleet:
                     # Span link: the stitched timeline's replica handoff
                     # — the destination's admit events follow it.
                     trace.link("migrated", from_replica=rep.idx,
-                               tokens=len(export_ids), cause="drain_eject")
+                               tokens=len(export_ids), cause="drain_eject",
+                               weights_version=pinned or "")
                 # Don't exclude by index: the nudged replica is already
                 # unroutable by STATE (draining/ejected), and the nudge
                 # may have hit a hedge branch — excluding the primary
@@ -709,9 +842,11 @@ class EngineFleet:
                 last_err = err
                 continue
             # outcome == "err"
-            err, ids = payload
+            err, ids, ver = payload
             if len(ids) > len(export_ids):
                 export_ids = ids
+            if export_ids and ver:
+                pinned = ver
             if isinstance(err, EngineOverloaded):
                 # Backpressure on ONE replica is a routing signal, not an
                 # engine failure: try the others once each.
@@ -734,7 +869,8 @@ class EngineFleet:
                     f"{len(export_ids)} generated tokens")
                 trace.link("migrated", from_replica=rep.idx,
                            tokens=len(export_ids),
-                           cause=type(err).__name__)
+                           cause=type(err).__name__,
+                           weights_version=pinned or "")
             logger.warning(
                 "fleet: migrating request off replica %d after %s "
                 "(%d generated tokens carried)", rep.idx,
@@ -763,7 +899,8 @@ class EngineFleet:
                               temperature: float,
                               timeout: Optional[float], seed: int,
                               resume_ids: Optional[List[int]],
-                              delivered: str):
+                              delivered: str,
+                              version: Optional[str] = None):
         """One (possibly hedged) dispatch, yielded incrementally:
 
         - ``("token", piece)`` — continuation text past the
@@ -783,6 +920,7 @@ class EngineFleet:
             tag = len(branches)
             export = RequestExport(ids=list(resume_ids or []))
             target.inflight += 1
+            target.dispatches += 1
             target.flights.add(flight)
             task = asyncio.create_task(self._pump(
                 tag, target, q,
@@ -806,6 +944,15 @@ class EngineFleet:
 
         def best_ids() -> List[int]:
             return list(max((b["export"].ids for b in branches), key=len))
+
+        def best_version() -> Optional[str]:
+            """The ENGINE's own stamp of which weights generated the
+            best export's ids (set at submit) — what the caller's
+            version pin routes on. None for base-protocol engines that
+            never see the export."""
+            e = max((b["export"] for b in branches),
+                    key=lambda ex: len(ex.ids))
+            return e.weights_version or None
 
         def bill_loser(b: dict, cause: str) -> None:
             """Flight recorder + goodput ledger for a losing hedge
@@ -835,7 +982,7 @@ class EngineFleet:
         winner: Optional[int] = None
         try:
             if flight.migrate.is_set():
-                yield ("migrate", None, list(resume_ids or []))
+                yield ("migrate", None, list(resume_ids or []), None)
                 return
             mig_task = asyncio.create_task(self._migrate_sentinel(flight, q))
             while True:
@@ -850,9 +997,12 @@ class EngineFleet:
                     # same request (same seed/resume — identical bytes)
                     # to a second replica and race the branches.
                     hedge_armed = False
+                    # Same-version only (ISSUE 13): the hedge's whole
+                    # contract is that both branches produce identical
+                    # bytes, which only holds on identical weights.
                     alt = self._route(
                         prompt, exclude=[b["rep"].idx for b in branches],
-                        lane=flight.lane)
+                        lane=flight.lane, version=version)
                     if alt is not None:
                         self._hedges += 1
                         trace = current_trace()
@@ -867,7 +1017,7 @@ class EngineFleet:
                     continue
                 tag, kind, val = item
                 if kind == "migrate":
-                    yield ("migrate", None, best_ids())
+                    yield ("migrate", None, best_ids(), best_version())
                     return
                 b = branches[tag]
                 if winner is None and kind == "ev":
@@ -908,7 +1058,7 @@ class EngineFleet:
                         continue
                     yield ("err", EngineUnavailable(
                         "replica stream ended without a result"),
-                        best_ids())
+                        best_ids(), best_version())
                     return
                 else:  # kind == "err"
                     b["dead"] = True
@@ -917,7 +1067,7 @@ class EngineFleet:
                         # The primary died before any event but a hedge
                         # is still running — let it win.
                         continue
-                    yield ("err", val, best_ids())
+                    yield ("err", val, best_ids(), best_version())
                     return
         finally:
             if mig_task is not None:
@@ -1205,17 +1355,31 @@ class EngineFleet:
                 "breaker": rep.breaker.state,
                 "occupancy": rep.occupancy(),
                 "inflight": rep.inflight,
+                "dispatches": rep.dispatches,
                 "migrations_out": rep.migrations_out,
+                "weights_version": rep.weights_version() or None,
                 "eject_cause": rep.eject_cause,
                 "last_error": rep.last_error or None,
                 "last_reset": reset_iso,
                 "last_reset_cause": cause,
             })
         counts = {s: 0 for s in REPLICA_STATES}
+        versions: Dict[str, int] = {}
         for rep in self.replicas:
             counts[rep.state] += 1
+            v = rep.weights_version()
+            if v:
+                versions[v] = versions.get(v, 0) + 1
         return {
             "size": len(self.replicas),
+            # Weight rollout (ISSUE 13): which checkpoint each replica
+            # serves — the version table /health and probe_serving
+            # print, and the rollout_replicas{version} gauge source.
+            "weights_version": self.weights_version or None,
+            "versions": versions,
+            "canary": ({"replica": self._canary_idx,
+                        "share": self._canary_share}
+                       if self._canary_idx is not None else None),
             "active": counts[REPLICA_ACTIVE],
             "draining": counts[REPLICA_DRAINING],
             "ejected": counts[REPLICA_EJECTED],
@@ -1289,6 +1453,7 @@ class EngineFleet:
                 "occupancy": s.get("batch_occupancy", rep.occupancy()),
                 "queue_depth": s.get("queue_depth", 0),
                 "migrations_out": rep.migrations_out,
+                "weights_version": rep.weights_version() or None,
             })
         agg["chunk_fetch_secs"] = fetch_samples
         agg["containment"] = containment

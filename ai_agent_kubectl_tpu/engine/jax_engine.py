@@ -132,6 +132,14 @@ class JaxEngine:
 
         self.tokenizer = tokenizer
         self.params = None
+        # Weight rollout (ISSUE 13): the checkpoint version this engine
+        # serves (content fingerprint — engine/rollout.py), stamped into
+        # /health per replica, echoed as X-Model-Version, and the pin
+        # key for cross-replica migration (cross-version replay cannot
+        # be byte-identical). checkpoint_path tracks the path the live
+        # params came from so a rollback knows what to restore.
+        self.weights_version = ""
+        self.checkpoint_path = model_path
         self._ready = False
         self._shutdown = False
         self._ladder_thread: Optional[threading.Thread] = None
@@ -459,6 +467,167 @@ class JaxEngine:
             self.params = shard_params(self.params, self.mesh, self.model_cfg)
             logger.info("Params sharded over mesh %s",
                         dict(self.mesh.shape))
+        if not self.weights_version:
+            # Version the weights we ended up serving: checkpoint paths
+            # fingerprint by content manifest; dev random-init versions
+            # by (model, seed) so two toy replicas built alike share a
+            # version (cross-replica byte-identity holds). A swap that
+            # already stamped a version keeps it across restarts. The
+            # dev sentinel doubles as a RESTORABLE checkpoint path —
+            # _load_swap_params parses its seed back out, so a rollback
+            # onto it re-derives the exact original random init.
+            from .rollout import checkpoint_version
+
+            dev_id = (f"dev:{self.model_cfg.name}:seed={self.seed}"
+                      f":quant={self.quant}")
+            if not self.checkpoint_path:
+                self.checkpoint_path = self.model_path or dev_id
+            self.weights_version = checkpoint_version(
+                self.model_path or dev_id)
+
+    def swap_weights(self, path: str, *, version: Optional[str] = None
+                     ) -> str:
+        """Swap the served checkpoint IN PLACE on a stopped (drained)
+        engine — the rollout tentpole's mechanism (engine/rollout.py).
+
+        The swap is ATOMIC and program-preserving:
+
+        - the new params load fully (and are validated against the live
+          tree's structure/shapes/dtypes) BEFORE the old tree is
+          released — any failure raises :class:`CheckpointCorrupt` and
+          the engine keeps serving the prior weights on restart;
+        - only ``self.params`` changes. Every compiled program set
+          (prefill buckets, decode chunks, splice/arm/COW) takes params
+          as a traced argument of unchanged shape, so the restart after
+          a swap re-executes warm programs — zero re-trace, no
+          multi-second first-request compile (asserted in
+          tests/test_rollout.py).
+
+        A path that exists loads through the normal checkpoint
+        converter; a path that does not exist serves random-init
+        weights keyed on the path (toy/dev mode, mirroring _load's
+        MODEL_PATH-less behaviour) so rollout drills run without a real
+        17 GB checkpoint on disk."""
+        from .rollout import CheckpointCorrupt, RolloutError, SwapFailed, \
+            checkpoint_version
+
+        if self._ready:
+            raise RolloutError(
+                "swap_weights requires a stopped (drained) engine")
+        version = version or checkpoint_version(path)
+        faults = getattr(self, "faults", None)
+        if faults is not None and hasattr(faults, "checkpoint_corrupt") \
+                and faults.checkpoint_corrupt():
+            raise CheckpointCorrupt(
+                f"checkpoint {path!r} failed integrity validation "
+                f"(injected checkpoint:corrupt drill)")
+        old = self.params
+        try:
+            new_params = self._load_swap_params(path)
+        except CheckpointCorrupt:
+            raise
+        except Exception as e:
+            raise CheckpointCorrupt(
+                f"checkpoint {path!r} failed to load: "
+                f"{type(e).__name__}: {e}") from e
+        if old is not None:
+            try:
+                import jax as _jax
+
+                match = _jax.tree_util.tree_all(_jax.tree_util.tree_map(
+                    lambda a, b: (getattr(a, "shape", None)
+                                  == getattr(b, "shape", None)
+                                  and getattr(a, "dtype", None)
+                                  == getattr(b, "dtype", None)),
+                    old, new_params))
+            except (ValueError, TypeError):
+                match = False
+            if not match:
+                # Wrong model/geometry: swapping it in would invalidate
+                # every compiled program (and likely OOM). Reject at
+                # load — the serving tree is untouched.
+                raise CheckpointCorrupt(
+                    f"checkpoint {path!r} does not match the serving "
+                    f"model's parameter tree "
+                    f"({self.model_cfg.name}, quant={self.quant or '-'})")
+        if faults is not None and hasattr(faults, "swap_fail") \
+                and faults.swap_fail():
+            # Mid-swap death: in a real buffer-donating swap the old
+            # tree is already released here. Model that honestly — the
+            # replica has NO servable weights until re-swapped, and its
+            # version/path stamps are cleared WITH the params: a later
+            # restart re-loads from MODEL_PATH and re-stamps truthfully
+            # in _load, instead of serving those bytes under the stale
+            # pre-swap version (which would let version-pinned failover
+            # splice established streams onto the wrong weights).
+            self.params = None
+            self.weights_version = ""
+            self.checkpoint_path = None
+            raise SwapFailed(
+                "injected swap:fail — replica died mid-swap")
+        if self.mesh is not None:
+            from ..parallel.sharding import shard_params
+
+            new_params = shard_params(new_params, self.mesh,
+                                      self.model_cfg)
+        self.params = new_params
+        self.weights_version = version
+        self.checkpoint_path = path
+        logger.info("weights swapped: %s now serves version %s (%s)",
+                    self.model_cfg.name, version, path)
+        return version
+
+    def _load_swap_params(self, path: str):
+        """Load (or dev-init) a parameter tree for ``swap_weights``
+        without touching the live ``self.params``."""
+        import os
+        import zlib as _zlib
+
+        import jax as _jax
+
+        if not path or not str(path).strip():
+            from .rollout import CheckpointCorrupt
+
+            raise CheckpointCorrupt("swap needs a checkpoint path")
+        path = str(path)
+        if os.path.exists(path):
+            from ..models.convert import convert_hf_checkpoint
+
+            logger.info("Loading swap checkpoint from %s (quant=%s)",
+                        path, self.quant or "-")
+            return convert_hf_checkpoint(
+                self.model_cfg, path, dtype=self.dtype,
+                quant=self.quant,
+                quantize_embed=self._quantize_embed)
+        # Dev/toy mode: a named-but-absent checkpoint serves random-init
+        # weights keyed on the path, so "swap to v2" is reproducible and
+        # genuinely different from v1 — the same contract _load applies
+        # to a missing MODEL_PATH.
+        logger.warning(
+            "Swap checkpoint %s does not exist; random-initializing %s "
+            "keyed on the path (toy/dev mode)", path,
+            self.model_cfg.name)
+        # A "dev:...:seed=N:..." sentinel (what _load records for a
+        # MODEL_PATH-less start) re-derives the EXACT original init —
+        # rolling back onto it is byte-identical restoration; any other
+        # absent path keys its init on the path string.
+        import re as _re
+
+        m = _re.search(r":seed=(\d+)", path) \
+            if path.startswith("dev:") else None
+        seed = (int(m.group(1)) if m
+                else _zlib.crc32(path.encode("utf-8", "surrogatepass"))
+                & 0x7FFFFFFF)
+        if self.quant in ("int8", "int4"):
+            from ..ops.quant import random_params_int8
+
+            return random_params_int8(
+                _jax.random.PRNGKey(seed), self.model_cfg,
+                dtype=self.dtype,
+                quantize_embed=self._quantize_embed,
+                int4=(self.quant == "int4"))
+        return init_params(_jax.random.PRNGKey(seed), self.model_cfg,
+                           dtype=self.dtype)
 
     def _prefill_impl_for(self, q_len: int, kv_len: int) -> str:
         """attn impl for a prefill shape, with per-shape dense fallback
@@ -493,6 +662,12 @@ class JaxEngine:
 
         self._prefill_raw = prefill
         for b in self.prefill_buckets:
+            if b in self._prefill_fns:
+                # stop() → start() restarts (weight swaps, fleet
+                # rejoins) keep the already-jitted program: params are a
+                # traced argument of unchanged shape, so reuse means the
+                # first post-swap request never re-compiles.
+                continue
             impl = self._prefill_impl_for(b, b)
             self._prefill_fns[b] = jax.jit(
                 partial(prefill, kv_limit=b, impl=impl), donate_argnums=(3,)
@@ -570,7 +745,9 @@ class JaxEngine:
                 lengths = jnp.full_like(cache.lengths, kv_tokens(pk))
             return KVCache(k=k, v=v, lengths=lengths)
 
-        self._splice_prefix_fn = jax.jit(splice_prefix, donate_argnums=(0,))
+        if self._splice_prefix_fn is None:   # restarts keep the program
+            self._splice_prefix_fn = jax.jit(splice_prefix,
+                                             donate_argnums=(0,))
 
         # Warm the smallest suffix program — it is the TTFT path for every
         # cache-hitting request.
@@ -1182,6 +1359,7 @@ class JaxEngine:
             prefix_cache_hit=prefix_hit,
             finish_reason=finish,
             engine=self.name,
+            weights_version=self.weights_version,
         )
         yield ("done", result)
 

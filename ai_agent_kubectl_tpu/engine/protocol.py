@@ -292,6 +292,12 @@ class RequestExport:
     #: winning branch's bytes were), so the engine's finish accounting
     #: must bill them as hedge_loser burn, not delivered goodput.
     discard: bool = False
+    #: weight rollout (ISSUE 13): the checkpoint version of the engine
+    #: that generated ``ids``, stamped at submit. A transcript is a
+    #: function of the weights, so a cross-version re-splice of these
+    #: ids cannot be byte-identical — the fleet router pins migration,
+    #: hedging, and replay failover to same-version replicas only.
+    weights_version: str = ""
 
 
 @dataclass
@@ -315,6 +321,9 @@ class EngineResult:
     prefix_cache_hit: bool = False
     finish_reason: str = "stop"  # stop | length | abort
     engine: str = ""
+    # Weight rollout (ISSUE 13): the checkpoint version of the weights
+    # that produced this text ("" for engines without versioning).
+    weights_version: str = ""
 
     @property
     def tokens_per_sec(self) -> float:

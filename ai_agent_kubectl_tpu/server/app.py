@@ -151,6 +151,34 @@ class Service:
         hook = getattr(engine, "set_reset_listener", None)
         if callable(hook):
             hook(self._on_engine_reset)
+        # Zero-downtime weight rollout (ISSUE 13): the controller drives
+        # drain → swap → warmup → rejoin → observe → promote-or-rollback
+        # over the fleet (or, degenerately, one swap-capable engine).
+        # Built against the UNWRAPPED engine — a generate-fault
+        # ChaosEngine sits above the fleet facade, and lifecycle calls
+        # must reach the real replicas.
+        self.rollout = None
+        target = getattr(engine, "inner", engine)
+        # Capability check reaches the REPLICA engines: a fleet of
+        # swap-less engines (ENGINE=fake FLEET_SIZE>1) must 404 the
+        # admin surface, not accept a rollout that would drain and
+        # eject a healthy replica before discovering the missing seam.
+        if hasattr(target, "replicas"):
+            swappable = all(
+                callable(getattr(rep.engine, "swap_weights", None))
+                for rep in target.replicas)
+        else:
+            swappable = callable(getattr(target, "swap_weights", None))
+        if swappable:
+            from ..engine.rollout import RolloutController
+
+            self.rollout = RolloutController(
+                target,
+                canary_share=cfg.rollout_canary_share,
+                observe_secs=cfg.rollout_observe_secs,
+                burn_gate=cfg.rollout_burn_gate,
+                drain_secs=cfg.drain_timeout_secs,
+            )
 
     def _on_engine_reset(self, cause: str) -> None:
         loop = self._loop
@@ -377,6 +405,12 @@ async def observability_middleware(request: web.Request, handler):
             timing = trace.server_timing()
             if timing:
                 response.headers["Server-Timing"] = timing
+            # Weight rollout (ISSUE 13): every response echoes the
+            # fleet-STABLE checkpoint version; per-replica truth (the
+            # canary included) lives in /health's version table.
+            ver = getattr(svc.engine, "weights_version", "")
+            if ver:
+                response.headers.setdefault("X-Model-Version", str(ver))
         return response
     except web.HTTPException as e:
         status = e.status
@@ -450,7 +484,8 @@ async def auth_middleware(request: web.Request, handler):
     configured."""
     svc: Service = request.app["service"]
     if svc.cfg.auth_enabled and (request.path in AUTH_ROUTES
-                                 or request.path.startswith("/debug/")):
+                                 or request.path.startswith("/debug/")
+                                 or request.path.startswith("/admin/")):
         key = request.headers.get("X-API-Key")
         if not key:
             logger.warning("Missing X-API-Key header.")
@@ -611,7 +646,17 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
                      sanitized_query, e)
         return _json_error(410, f"Request quarantined: {e}")
     except EngineUnavailable as e:
-        return _json_error(503, f"Engine not available: {e}")
+        headers = None
+        if svc.rollout is not None and svc.rollout.active:
+            # Weight rollout (ISSUE 13): while a swap holds the only
+            # capacity (the FLEET_SIZE=1 in-place swap runs WITHOUT a
+            # fleet facade to price the shed), tell the LB when to
+            # re-offer instead of returning a bare 503.
+            hint = float(getattr(svc.engine, "swap_hint", 0.0) or 0.0)
+            headers = _retry_after_header(
+                hint or max(2.0, svc.rollout.drain_secs / 2.0))
+        return _json_error(503, f"Engine not available: {e}",
+                           headers=headers)
     except (GenerationTimeout, asyncio.TimeoutError):
         logger.error("Engine timed out after %ss for query: %s", svc.cfg.llm_timeout, sanitized_query)
         return _json_error(504, "LLM request timed out")
@@ -701,6 +746,13 @@ async def handle_kubectl_command_stream(request: web.Request) -> web.StreamRespo
         # has run — the middleware can't stamp them afterwards. The ID is
         # known now; Server-Timing (whose values aren't) stays JSON-only.
         resp.headers["X-Request-ID"] = trace.request_id
+    # Weight rollout (ISSUE 13): the stream commits to the fleet-stable
+    # version before the first byte; version pinning (engine/fleet.py)
+    # then guarantees an established stream never silently crosses onto
+    # other weights mid-flight.
+    _ver = getattr(svc.engine, "weights_version", "")
+    if _ver:
+        resp.headers["X-Model-Version"] = str(_ver)
     await resp.prepare(request)
 
     def sse(payload: str, event: Optional[str] = None) -> bytes:
@@ -963,6 +1015,11 @@ async def handle_health(request: web.Request) -> web.Response:
     sph = getattr(svc.engine, "spec_health", None)
     if callable(sph):
         spec = sph() or None
+    # Weight rollout (ISSUE 13): state machine position, target/stable
+    # versions, the per-replica version table, rollbacks by cause —
+    # cheap controller counters, same rule as the rest. The fleet
+    # section above carries each replica's weights_version too.
+    rollout = svc.rollout.health() if svc.rollout is not None else None
     body = HealthResponse(
         status="healthy" if ready and breaker == "closed" else "degraded",
         engine=getattr(svc.engine, "name", "unknown"),
@@ -979,6 +1036,7 @@ async def handle_health(request: web.Request) -> web.Response:
         kv_pool=kv_pool,
         grammar=grammar,
         spec=spec,
+        rollout=rollout,
     )
     # The HTTP status tracks engine readiness alone: an open breaker with
     # the engine process alive still serves (fallback and/or cache), and
@@ -1135,6 +1193,76 @@ async def handle_debug_ledger(request: web.Request) -> web.Response:
     return web.json_response(snap)
 
 
+def _rollout_unavailable(svc: Service) -> Optional[web.Response]:
+    if svc.rollout is None:
+        return _json_error(
+            404, "engine has no weight-rollout support (rollouts are "
+                 "wired into the fleet and the swap-capable engines)")
+    return None
+
+
+async def handle_admin_rollout_post(request: web.Request) -> web.Response:
+    """POST /admin/rollout {"checkpoint": path} — begin a zero-downtime
+    weight rollout (ISSUE 13): drain one canary replica, swap it to the
+    versioned checkpoint, observe it under a bounded traffic share, then
+    promote the rest or roll back automatically. Token-gated like the
+    debug surfaces — weight changes are operator actions."""
+    denied = _debug_forbidden(request)
+    if denied is not None:
+        return denied
+    svc: Service = request.app["service"]
+    unavailable = _rollout_unavailable(svc)
+    if unavailable is not None:
+        return unavailable
+    try:
+        body = await request.json()
+    except Exception:
+        return _json_error(400, "body must be JSON")
+    checkpoint = (body or {}).get("checkpoint")
+    if not isinstance(checkpoint, str) or not checkpoint.strip():
+        return _json_error(400, "body needs a 'checkpoint' path string")
+    from ..engine.rollout import RolloutError
+
+    try:
+        status = await svc.rollout.start_rollout(checkpoint.strip())
+    except RolloutError as e:
+        return _json_error(409, str(e))
+    return web.json_response(status, status=202)
+
+
+async def handle_admin_rollout_get(request: web.Request) -> web.Response:
+    """GET /admin/rollout — the rollout state machine's full status:
+    state, target/stable versions, canary + share, gate verdicts, the
+    drain→swap→rejoin→promote timeline, and rollback history."""
+    denied = _debug_forbidden(request)
+    if denied is not None:
+        return denied
+    svc: Service = request.app["service"]
+    unavailable = _rollout_unavailable(svc)
+    if unavailable is not None:
+        return unavailable
+    return web.json_response(svc.rollout.status())
+
+
+async def handle_admin_rollout_abort(request: web.Request) -> web.Response:
+    """POST /admin/rollout/abort — roll the in-flight rollout back
+    (cause ``aborted``); 409 when nothing is in flight."""
+    denied = _debug_forbidden(request)
+    if denied is not None:
+        return denied
+    svc: Service = request.app["service"]
+    unavailable = _rollout_unavailable(svc)
+    if unavailable is not None:
+        return unavailable
+    from ..engine.rollout import RolloutError
+
+    try:
+        status = await svc.rollout.abort()
+    except RolloutError as e:
+        return _json_error(409, str(e))
+    return web.json_response(status)
+
+
 async def handle_metrics(request: web.Request) -> web.Response:
     svc: Service = request.app["service"]
     # Engine gauges are sampled at scrape time (live scheduler state, not a
@@ -1179,6 +1307,11 @@ async def handle_metrics(request: web.Request) -> web.Response:
         # the acceptance-ratio gauge — same delta-mirror pattern.
         if stats.get("spec"):
             svc.metrics.observe_spec(stats["spec"])
+    # Weight rollout (ISSUE 13): state gauge + per-version replica
+    # counts + rollbacks{cause} — the controller sits ABOVE the engine
+    # seam, so it mirrors from its own health view, not stats().
+    if svc.rollout is not None:
+        svc.metrics.observe_rollout(svc.rollout.health())
     # Windowed throughput gauge: the batcher's own scheduler-side window
     # when it reports one (counts every finish, including streams), else
     # the service-side window fed by the response handlers.
@@ -1209,6 +1342,9 @@ def create_app(cfg: ServiceConfig, engine: Engine,
     app.router.add_get("/debug/requests/{id}", handle_debug_request_detail)
     app.router.add_get("/debug/chunks", handle_debug_chunks)
     app.router.add_get("/debug/ledger", handle_debug_ledger)
+    app.router.add_post("/admin/rollout", handle_admin_rollout_post)
+    app.router.add_get("/admin/rollout", handle_admin_rollout_get)
+    app.router.add_post("/admin/rollout/abort", handle_admin_rollout_abort)
     app.router.add_get("/health", handle_health)
     app.router.add_get("/metrics", handle_metrics)
     # /openapi.json + /docs — unauthenticated like the reference's

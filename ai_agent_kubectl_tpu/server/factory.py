@@ -112,7 +112,8 @@ def build_engine(cfg: ServiceConfig) -> Engine:
         # boot instead. (FakeChunkedEngine also speaks decode/scheduler,
         # but it is a test harness, not a factory-selectable ENGINE.)
         needs_batcher = [p for p in ("admit", "chunk", "decode", "scheduler",
-                                     "tenant", "draft")
+                                     "tenant", "draft", "swap",
+                                     "checkpoint")
                          if injector.has_any(p)]
         batched = cfg.engine in ("jax", "jax-batched") and (
             cfg.engine == "jax-batched" or cfg.decode_batch_size > 1)

@@ -503,6 +503,36 @@ class Metrics:
         )
         self._spec_seen = {"drafted": 0, "accepted": 0}
 
+        # Zero-downtime weight rollout (ISSUE 13, engine/rollout.py):
+        # the state machine's current state (encoded by index into the
+        # closed ROLLOUT_STATES set), replicas by serving weights
+        # version (cardinality bounded per scrape — stale version
+        # labels are zeroed, and at most FLEET_SIZE+1 versions can be
+        # live at once), and automatic rollbacks by cause (closed
+        # ROLLBACK_CAUSES set), delta-mirrored from the controller's
+        # cumulative totals like every other subsystem.
+        self.rollout_state = Gauge(
+            "rollout_state",
+            "Weight-rollout state machine position (0=idle, 1=draining, "
+            "2=swapping, 3=warming, 4=observing, 5=promoting, "
+            "6=rolling_back, 7=rolled_back, 8=complete, 9=failed)",
+            registry=r,
+        )
+        self.rollout_replicas = Gauge(
+            "rollout_replicas",
+            "Fleet replicas by the checkpoint version they serve",
+            ["version"],
+            registry=r,
+        )
+        self.rollout_rollbacks = Counter(
+            "rollout_rollbacks_total",
+            "Automatic weight-rollout rollbacks",
+            ["cause"],
+            registry=r,
+        )
+        self._rollout_seen: dict = {}
+        self._rollout_versions_seen: set = set()
+
         # Request-lifecycle phase attribution (obs/trace.py): where a
         # request's wall time went. The ``phase`` label is drawn from the
         # fixed obs.PHASES allowlist — cardinality is bounded by
@@ -694,6 +724,36 @@ class Metrics:
         if drafted:
             self.spec_acceptance_ratio.set(
                 spec.get("accepted_tokens_total", 0) / drafted)
+
+    def observe_rollout(self, rollout: dict) -> None:
+        """Mirror the rollout controller's health view into Prometheus
+        at scrape time — state gauge set by index, per-version replica
+        counts set (stale version labels zeroed so a completed rollout
+        doesn't leave the old version reading 1 forever), rollback
+        causes delta-inc'd."""
+        from ..engine.rollout import ROLLOUT_STATES
+
+        try:
+            code = ROLLOUT_STATES.index(rollout.get("state", "idle"))
+        except ValueError:   # pragma: no cover - future state
+            code = 0
+        self.rollout_state.set(code)
+        versions: dict = {}
+        for v in (rollout.get("replica_versions") or {}).values():
+            if v:
+                versions[v] = versions.get(v, 0) + 1
+        for v, n in versions.items():
+            self.rollout_replicas.labels(version=v).set(n)
+            self._rollout_versions_seen.add(v)
+        for v in self._rollout_versions_seen - set(versions):
+            self.rollout_replicas.labels(version=v).set(0)
+        for cause, total in (rollout.get("rollbacks_total")
+                             or {}).items():
+            prev = self._rollout_seen.get(cause, 0)
+            if total > prev:
+                self.rollout_rollbacks.labels(cause=cause).inc(
+                    total - prev)
+                self._rollout_seen[cause] = total
 
     def observe_slo(self, slo: dict) -> None:
         """Mirror the SLO burn snapshot (stats()["slo"]) into

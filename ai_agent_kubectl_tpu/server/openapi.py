@@ -289,6 +289,67 @@ def build_openapi() -> Dict:
                 "404": _err("Engine exposes no goodput ledger"),
             },
         }},
+        "/admin/rollout": {
+            "post": {
+                "summary": "Begin a zero-downtime weight rollout "
+                           "(canary → gate → promote-or-rollback)",
+                "description": "Drains one canary replica, swaps it to "
+                               "the versioned checkpoint (content "
+                               "fingerprint = version; compiled serving "
+                               "programs are reused — no re-trace), "
+                               "rejoins it, steers ROLLOUT_CANARY_SHARE "
+                               "of fresh traffic at it for "
+                               "ROLLOUT_OBSERVE_SECS, then promotes the "
+                               "remaining replicas or rolls back "
+                               "automatically on SLO-burn/goodput/"
+                               "counter gate breach. Same auth/token "
+                               "gating as /debug/profile.",
+                "requestBody": {"required": True, "content": {
+                    "application/json": {"schema": {
+                        "type": "object",
+                        "required": ["checkpoint"],
+                        "properties": {"checkpoint": {
+                            "type": "string",
+                            "description": "Checkpoint path to roll to",
+                        }},
+                    }}}},
+                "responses": {
+                    "202": {"description": "Rollout started; body is "
+                                           "the initial status"},
+                    "400": _err("Missing/invalid checkpoint path"),
+                    "401": auth_err,
+                    "403": _err("Invalid or missing X-Debug-Token"),
+                    "404": _err("Engine has no weight-rollout support"),
+                    "409": _err("A rollout is already in progress / "
+                                "fleet already serves that version"),
+                },
+            },
+            "get": {
+                "summary": "Rollout status: state machine, versions, "
+                           "gate verdicts, timeline, rollback history",
+                "responses": {
+                    "200": {"description": "{state, target_version, "
+                                           "stable_version, "
+                                           "canary_replica, last_gate, "
+                                           "events, history, ...}"},
+                    "401": auth_err,
+                    "403": _err("Invalid or missing X-Debug-Token"),
+                    "404": _err("Engine has no weight-rollout support"),
+                },
+            },
+        },
+        "/admin/rollout/abort": {"post": {
+            "summary": "Abort the in-flight rollout (automatic "
+                       "rollback, cause 'aborted')",
+            "responses": {
+                "200": {"description": "Rollback finished; body is the "
+                                       "final status"},
+                "401": auth_err,
+                "403": _err("Invalid or missing X-Debug-Token"),
+                "404": _err("Engine has no weight-rollout support"),
+                "409": _err("No rollout in progress"),
+            },
+        }},
     }
 
     return {

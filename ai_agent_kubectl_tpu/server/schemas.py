@@ -121,3 +121,9 @@ class HealthResponse(BaseModel):
     # acceptance ratio. None = SPEC_DECODE off or an engine without the
     # subsystem.
     spec: Optional[Dict[str, Any]] = None
+    # Zero-downtime weight rollout (ISSUE 13, engine/rollout.py): the
+    # state machine position, target/stable checkpoint versions, the
+    # canary replica + share, the per-replica version table, and
+    # cumulative rollbacks by cause. None = engine without swap support
+    # (the per-replica versions also appear in the fleet section).
+    rollout: Optional[Dict[str, Any]] = None

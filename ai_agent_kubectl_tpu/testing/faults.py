@@ -27,6 +27,13 @@ fault points that the engine layer checks at its seams:
   degrade to plain (non-speculative) decode without failing a single
   in-flight request, which is exactly what exact-match verification
   guarantees (the transcript never depended on the drafts)
+- ``swap`` — ``swap:fail`` kills the next weight swap MID-swap
+  (ISSUE 13): one-shot, checked inside ``swap_weights`` after the old
+  buffers are notionally released — the replica stays ejected with
+  cause ``swap_failed`` and the rollout auto-rolls the fleet back
+- ``checkpoint`` — ``checkpoint:corrupt`` fails the next checkpoint
+  LOAD's integrity validation (ISSUE 13): one-shot; the swap is atomic
+  so the prior weights stay armed and the rollout rolls back onto them
 - ``generate`` — the whole engine call (applied by ``ChaosEngine``, the
   protocol wrapper the factory installs when FAULT_POINTS names it)
 
@@ -40,6 +47,9 @@ Modes (the third ``:``-field is mode-specific):
 - ``nan[:rate]`` — (``decode`` only) corrupt one slot's logits
 - ``poison_step[:rate]`` — (``decode`` only) raise from the chunk fetch
 - ``die`` — (``scheduler`` only) kill the scheduler loop, one-shot
+- ``fail`` — (``swap`` only) die mid-weight-swap, one-shot
+- ``corrupt`` — (``checkpoint`` only) fail checkpoint load validation,
+  one-shot
 
 Targeting: by default ``decode`` faults pick the first live slot. Tests
 that need the fault to FOLLOW one request across resets/replays set
@@ -66,20 +76,23 @@ from ..engine.protocol import EngineResult, EngineUnavailable
 
 _DEFAULT_HANG_SECS = 60.0
 
-_MODES = ("error", "delay", "hang", "nan", "poison_step", "die", "flood")
+_MODES = ("error", "delay", "hang", "nan", "poison_step", "die", "flood",
+          "fail", "corrupt")
 
 #: the closed set of check sites; a typo'd point in FAULT_POINTS must be
 #: a startup error, not a silently inert game-day drill.
 KNOWN_POINTS = ("admit", "chunk", "decode", "scheduler", "tenant",
-                "draft", "generate")
+                "draft", "swap", "checkpoint", "generate")
 
 #: (point, mode) pairs that only make sense together — a drill spec
 #: arming e.g. ``admit:nan`` is a typo, not chaos.
 _POINT_ONLY_MODES = {"nan": ("decode",), "poison_step": ("decode",),
-                     "die": ("scheduler", "draft"), "flood": ("tenant",)}
+                     "die": ("scheduler", "draft"), "flood": ("tenant",),
+                     "fail": ("swap",), "corrupt": ("checkpoint",)}
 _RESTRICTED_POINTS = {"decode": ("nan", "poison_step"),
                       "scheduler": ("die",), "tenant": ("flood",),
-                      "draft": ("die",)}
+                      "draft": ("die",), "swap": ("fail",),
+                      "checkpoint": ("corrupt",)}
 
 #: tenant key + lane the flood drill's synthetic burst runs under —
 #: fixed so fairness assertions and dashboards can name the flooder.
@@ -394,6 +407,35 @@ class FaultInjector:
         self._fired["draft"] = self._fired.get("draft", 0) + 1
         return True
 
+    def _one_shot(self, point: str, mode: str,
+                  replica: Optional[int]) -> bool:
+        """Shared one-shot check (swap:fail / checkpoint:corrupt):
+        fires at most once, disarms itself, returns whether it fired."""
+        fault = self._faults.get(point)
+        if fault is None or fault.mode != mode:
+            return False
+        if not self._in_scope(fault, replica):
+            return False
+        del self._faults[point]
+        self._fired[point] = self._fired.get(point, 0) + 1
+        return True
+
+    def swap_fail(self, replica: Optional[int] = None) -> bool:
+        """``swap:fail`` — one-shot (ISSUE 13): the next weight swap
+        through an armed engine dies MID-swap (old buffers released,
+        new ones never armed). The engine raises ``SwapFailed``, the
+        replica stays ejected with cause ``swap_failed``, and the
+        rollout controller auto-aborts and rolls the fleet back."""
+        return self._one_shot("swap", "fail", replica)
+
+    def checkpoint_corrupt(self, replica: Optional[int] = None) -> bool:
+        """``checkpoint:corrupt`` — one-shot (ISSUE 13): the next
+        checkpoint LOAD through an armed engine fails integrity
+        validation. Unlike ``swap:fail`` the swap is atomic — the prior
+        weights stay armed, the engine raises ``CheckpointCorrupt``,
+        and the rollout rolls back with the prior weights restored."""
+        return self._one_shot("checkpoint", "corrupt", replica)
+
     def check_scheduler_die(self, replica: Optional[int] = None) -> None:
         """``scheduler:die`` — one-shot: raises ``SchedulerKilled`` (a
         BaseException) so the scheduler loop genuinely dies; disarms
@@ -467,6 +509,12 @@ class ReplicaFaults:
     def draft_die(self) -> bool:
         return self.inner.draft_die(replica=self.replica)
 
+    def swap_fail(self) -> bool:
+        return self.inner.swap_fail(replica=self.replica)
+
+    def checkpoint_corrupt(self) -> bool:
+        return self.inner.checkpoint_corrupt(replica=self.replica)
+
     def tenant_flood(self) -> int:
         return self.inner.tenant_flood(replica=self.replica)
 
@@ -491,6 +539,12 @@ class ChaosEngine:
     @property
     def ready(self) -> bool:
         return self.inner.ready
+
+    @property
+    def weights_version(self) -> str:
+        """Forward the served checkpoint version (ISSUE 13) so the
+        X-Model-Version header survives the wrapper."""
+        return str(getattr(self.inner, "weights_version", "") or "")
 
     async def start(self) -> None:
         await self.inner.start()

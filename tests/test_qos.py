@@ -678,9 +678,15 @@ async def test_jax_preempted_victim_replays_byte_identical(
     try:
         bg_tasks = [asyncio.create_task(run_bg(p, t, s))
                     for p, t, s in BG_REQS]
-        for _ in range(800):            # both slots genuinely decoding
+        # Both slots seated AND past their first consumed token: a
+        # victim preempted at zero generated tokens legitimately
+        # re-admits as FRESH (no "replayed into slot" event — the
+        # documented zero-token path), so the handoff assertion below
+        # needs every candidate victim to have something to carry.
+        for _ in range(800):
             await asyncio.sleep(0.005)
-            if all(s is not None for s in eng._slots):
+            if all(s is not None and len(s.detok.ids) > 0
+                   for s in eng._slots):
                 break
         else:
             pytest.fail("background never filled the slots")

@@ -134,6 +134,7 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
     print_kv_pool_summary(gauges)
     print_grammar_summary(gauges)
     print_fleet_summary(gauges)
+    print_rollout_summary(gauges)
     print_qos_summary(gauges)
     print_goodput_summary(gauges)
     print_spec_summary(gauges)
@@ -257,6 +258,39 @@ def print_fleet_summary(gauges: Dict[str, float]) -> None:
     consumed = gauges.get('decode_chunks_total{event="consume"}', 0.0)
     rate = f"  ({hedges / consumed:.4f}/chunk)" if consumed else ""
     log(f"  hedged dispatches total     {hedges:>8.0f}{rate}")
+
+
+#: rollout_state gauge encoding (engine/rollout.py ROLLOUT_STATES).
+_ROLLOUT_STATES = ("idle", "draining", "swapping", "warming", "observing",
+                   "promoting", "rolling_back", "rolled_back", "complete",
+                   "failed")
+
+
+def print_rollout_summary(gauges: Dict[str, float]) -> None:
+    """Weight-rollout view (ISSUE 13) from the same /metrics scrape:
+    the state machine position, the per-version replica table (which
+    checkpoint each part of the fleet serves), and rollbacks by cause
+    — the zero-downtime-deploy dashboard next to the fleet view."""
+    versions = _sum_labelled(gauges, "rollout_replicas")
+    state = gauges.get("rollout_state")
+    if state is None and not versions:
+        return      # engine without weight-rollout support
+    name = (_ROLLOUT_STATES[int(state)]
+            if state is not None and 0 <= int(state) < len(_ROLLOUT_STATES)
+            else "?")
+    log("probe[rollout]: weight rollout")
+    log(f"  state                       {name:>12}")
+    for key in sorted(versions):
+        ver = key.split("=")[-1].strip('"')
+        if versions[key] > 0:
+            log(f"  version {ver:<18} replicas={versions[key]:.0f}")
+    rollbacks = _sum_labelled(gauges, "rollout_rollbacks_total")
+    total = sum(rollbacks.values())
+    causes = ", ".join(
+        f"{k.split('=')[-1].strip(chr(34))}={v:.0f}"
+        for k, v in sorted(rollbacks.items()) if v > 0)
+    log(f"  rollbacks total             {total:>8.0f}"
+        + (f"  ({causes})" if causes else ""))
 
 
 def print_qos_summary(gauges: Dict[str, float]) -> None:
