@@ -78,6 +78,23 @@ def parse_rate_limit(spec: str) -> Tuple[int, float]:
     return count, float(window)
 
 
+def _mesh_device_count(spec: str) -> int:
+    """Device count a MESH_SHAPE/DCN_MESH_SHAPE spec asks for (product
+    of its axis sizes; 1 for empty). A jax-free mirror of
+    parallel/mesh.py::MeshConfig.parse's arithmetic — config validation
+    must not import jax (the fake/openai deployments stay jax-free),
+    and malformed axis names are the engine's error to raise, so
+    unknown parts simply count their integer value."""
+    total = 1
+    for part in filter(None, (p.strip() for p in (spec or "").split(","))):
+        _, _, val = part.replace(":", "=").partition("=")
+        try:
+            total *= max(1, int(val))
+        except ValueError:
+            continue
+    return total
+
+
 def _env_str(name: str, default: Optional[str]) -> Optional[str]:
     v = os.getenv(name)
     return v if v not in (None, "") else default
@@ -554,6 +571,20 @@ class ServiceConfig:
                     f"(vocab {draft.vocab_size}) does not share "
                     f"{self.model_name!r}'s vocab ({target.vocab_size}) "
                     f"— draft and verifier must use one tokenizer")
+            # ISSUE 14: the KV pool now serves under TP/EP meshes, which
+            # makes SPEC_DECODE + MESH_SHAPE *reachable* — but the draft
+            # engine's dense per-slot cache and the multi-token verify
+            # window have no sharded variants. Refuse loudly at boot
+            # rather than silently mis-compose (the engine re-checks at
+            # start for direct construction).
+            mesh_devs = (_mesh_device_count(self.mesh_shape)
+                         * _mesh_device_count(self.dcn_mesh_shape))
+            if mesh_devs > 1:
+                raise ValueError(
+                    f"SPEC_DECODE does not compose with a multi-device "
+                    f"serving mesh (MESH_SHAPE={self.mesh_shape!r} "
+                    f"DCN_MESH_SHAPE={self.dcn_mesh_shape!r} = "
+                    f"{mesh_devs} devices); disable one of them")
 
     @property
     def tenant_tier_map(self) -> dict:

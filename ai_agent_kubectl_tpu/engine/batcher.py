@@ -792,6 +792,12 @@ class BatchedJaxEngine(JaxEngine):
         self.radix_cache = bool(radix_cache)
         self.radix_lru_blocks = max(0, radix_lru_blocks)
         self._use_pool = False        # resolved at start (mesh fallback)
+        # True when KV_POOL was requested but the mesh forced the dense
+        # ladder (data/pipe/seq axes >1 — the pool's block axis is a
+        # shared structure across slots and can't shard over them).
+        # Surfaced in /health's sharding section + the
+        # kv_pool_mesh_fallback gauge so the fallback is never silent.
+        self._kv_pool_mesh_fallback = False
         self._pool: Optional[BlockPool] = None
         self._radix: Optional[RadixCache] = None
         self._pool_prefill_fns: dict = {}   # (bucket, kv_limit) -> jitted
@@ -1053,17 +1059,37 @@ class BatchedJaxEngine(JaxEngine):
         self._first_consumed = False  # re-arm the cold-start watchdog grace
         self._setup_compile_cache()
         self._setup_mesh()
+        # Speculative decoding never composes with a multi-device mesh:
+        # the draft's dense per-slot cache and the verify window's
+        # multi-token forward have no sharded variants, and a silently
+        # mis-composed draft would burn chips without the parity
+        # guarantee. Config validation rejects the combination at boot;
+        # this is the belt-and-braces check for direct construction.
+        if (self.spec_decode and self.mesh is not None
+                and self.mesh.size > 1):
+            raise ValueError(
+                "SPEC_DECODE does not compose with a multi-device "
+                "serving mesh (MESH_SHAPE); disable one of them")
         self._load()
-        # Block-paged KV pool (ISSUE 10): the default serving layout. A
-        # serving mesh falls back to the dense ladder — the pool is a
-        # SHARED structure across slots, so the slots-over-``data``
-        # sharding does not apply (full-residual TP pool sharding is
-        # ROADMAP item 4's step).
-        self._use_pool = self.kv_pool and self.mesh is None
-        if self.kv_pool and self.mesh is not None:
+        # Block-paged KV pool (ISSUE 10 → ISSUE 14): the default
+        # serving layout, now composing with TP/EP serving meshes — the
+        # pool cache shards on the KV-head axis exactly like dense KV
+        # (parallel/sharding.py::pool_cache_specs) and block tables stay
+        # per-slot host numpy. Only meshes with a >1 data/pipe/seq axis
+        # still force the dense ladder: the pool's block axis is shared
+        # across slots (no slots-over-``data`` partition exists) and the
+        # pipe stage body has no table plumbing. That fallback is LOUD:
+        # kv_pool_mesh_fallback rides /health + /metrics.
+        mesh_pool_ok = self.mesh is None or all(
+            self.mesh.shape[a] == 1 for a in ("data", "pipe", "seq"))
+        self._use_pool = self.kv_pool and mesh_pool_ok
+        self._kv_pool_mesh_fallback = bool(self.kv_pool
+                                           and not mesh_pool_ok)
+        if self._kv_pool_mesh_fallback:
             logger.warning(
-                "KV_POOL does not compose with a serving mesh yet; "
-                "falling back to the dense KV ladder")
+                "KV_POOL does not compose with data/pipe/seq mesh axes "
+                "(mesh %s); falling back to the dense KV ladder",
+                dict(self.mesh.shape))
         if self.grammar_decode and self._grammar is None:
             # Grammar runtime (ISSUE 11): compile the kubectl grammar
             # against THIS tokenizer. Host numpy truth; the stacked
@@ -1090,8 +1116,9 @@ class BatchedJaxEngine(JaxEngine):
         # model. Pool-only — the rejected-row discipline ("last
         # generated row unwritten", replay chains stop at emitted[:-1])
         # is the pool contract, and the pool is the default layout; the
-        # dense ladder (and therefore any serving mesh) falls back to
-        # plain decode exactly like KV_POOL itself falls back.
+        # dense ladder falls back to plain decode. (Multi-device meshes
+        # were already refused above — ISSUE 14 made pool+mesh serve,
+        # so the pool gate alone no longer keeps spec+mesh unreachable.)
         self._use_spec = self.spec_decode and self._use_pool
         if self.spec_decode and not self._use_pool:
             logger.warning(
@@ -1205,6 +1232,19 @@ class BatchedJaxEngine(JaxEngine):
                         "head_dim=%d; using the gather path",
                         self.kv_pool_page, cfg.head_dim)
                     decode_impl = "dense"
+            if (decode_impl == "paged" and self.mesh is not None
+                    and self.mesh.shape["model"] > 1
+                    and (cfg.n_kv_heads % self.mesh.shape["model"]
+                         or cfg.n_heads % self.mesh.shape["model"])):
+                # The shard_mapped pool kernel splits Q and KV heads
+                # together over ``model`` (whole KV groups per shard);
+                # geometries that don't divide serve the gather path.
+                logger.warning(
+                    "paged pool decode needs KV (%d) and H (%d) "
+                    "divisible by the model axis (%d); using the "
+                    "gather path", cfg.n_kv_heads, cfg.n_heads,
+                    self.mesh.shape["model"])
+                decode_impl = "dense"
             if decode_impl == "paged" and self._use_spec:
                 # The verify step is a (k+1)-token window — the paged
                 # decode kernel is single-query. Keep the dense gather
@@ -1306,10 +1346,13 @@ class BatchedJaxEngine(JaxEngine):
 
             if self._use_pool:
                 def step(params, tok, pos, cache, live, tables):
+                    # mesh rides into the pool path too (ISSUE 14):
+                    # KV-head-sharded pool scatter/gather, f≈1 residual
+                    # constraints, and the shard_mapped pool kernel.
                     return forward(params, cfg, tok, pos, cache,
                                    kv_limit=kv_limit,
                                    attn_impl=self._decode_impl,
-                                   mesh=None,
+                                   mesh=self.mesh,
                                    moe_impl=self.moe_impl,
                                    token_mask=live[:, None],
                                    write_mask=live,
@@ -1371,8 +1414,7 @@ class BatchedJaxEngine(JaxEngine):
                 logits, cache = forward(params, cfg, tok, pos, cache,
                                         kv_limit=kv_limit,
                                         attn_impl=self._decode_impl,
-                                        mesh=(None if tables is not None
-                                              else self.mesh),
+                                        mesh=self.mesh,
                                         moe_impl=self.moe_impl,
                                         token_mask=force[:, None],
                                         page_size=(self.kv_pool_page
@@ -1768,9 +1810,33 @@ class BatchedJaxEngine(JaxEngine):
                 return QuantKV(q=jnp.zeros(shape, jnp.int8),
                                s=jnp.ones(shape[:-1], jnp.float32))
 
-            return KVCache(k=zq(), v=zq(), lengths=lengths)
-        return KVCache(k=jnp.zeros(shape, self.dtype),
-                       v=jnp.zeros(shape, self.dtype), lengths=lengths)
+            cache = KVCache(k=zq(), v=zq(), lengths=lengths)
+        else:
+            cache = KVCache(k=jnp.zeros(shape, self.dtype),
+                            v=jnp.zeros(shape, self.dtype),
+                            lengths=lengths)
+        if self.mesh is not None:
+            # Pool-under-mesh (ISSUE 14): KV heads shard over ``model``
+            # exactly like dense KV; the block axis stays whole (it is
+            # shared across slots). Every jitted pool program — prefill
+            # through tables, COW, the decode chunk — inherits this
+            # placement, so XLA keeps TP attention local per shard
+            # until the wo reduce.
+            from ..parallel.sharding import shard_pool_cache
+
+            cache = shard_pool_cache(cache, self.mesh, self.model_cfg)
+        return cache
+
+    def _tables_d(self, tables: np.ndarray):
+        """Device copy of a block-table snapshot — committed REPLICATED
+        under a mesh (tables are per-slot host truth; the compiled
+        chunk/prefill programs expect the replicated layout, and an
+        uncommitted array would reshard per dispatch)."""
+        if self.mesh is None:
+            return jnp.asarray(tables)
+        from ..parallel.sharding import replicate
+
+        return replicate(np.ascontiguousarray(tables), self.mesh)
 
     def _pool_kv_limit(self, needed: int) -> int:
         """Smallest PREFILL KV bucket covering ``needed`` positions
@@ -1801,7 +1867,7 @@ class BatchedJaxEngine(JaxEngine):
                                    0)
                 return forward(params, cfg, tokens, positions, cache,
                                kv_limit=kv_limit, attn_impl=impl,
-                               mesh=None, moe_impl=self.moe_impl,
+                               mesh=self.mesh, moe_impl=self.moe_impl,
                                token_mask=mask, logits_at=last,
                                page_size=self.kv_pool_page,
                                block_tables=tables)
@@ -1907,7 +1973,7 @@ class BatchedJaxEngine(JaxEngine):
         Returns the last valid position's logits [1, V]."""
         n = len(ids)
         big = self.prefill_buckets[-1]
-        tables_d = jnp.asarray(table_row[None])
+        tables_d = self._tables_d(table_row[None])
         offset, logits = start, None
         while offset < n:
             L = min(big, n - offset)
@@ -2138,7 +2204,7 @@ class BatchedJaxEngine(JaxEngine):
         )
         self._run_arm(0, 1, jnp.zeros((1,), jnp.int32), 0.0, 1, 0, 1)
         self._run_cow(blocks[0], blocks[0], 0)
-        tables_d = jnp.asarray(self._tables)
+        tables_d = self._tables_d(self._tables)
         for kv_b in self._kv_buckets:
             packed = self._run_chunk(kv_b, jnp.zeros((N,), jnp.bool_),
                                      self._no_corrupt_d, tables_d,
@@ -2199,6 +2265,26 @@ class BatchedJaxEngine(JaxEngine):
         logger.info(
             "Radix cache preloaded: %d-token system prompt resident in "
             "%d pool blocks", P, need)
+
+    def sharding_health(self) -> Optional[dict]:
+        """Cheap sharding view for /health (ISSUE 14; host attributes
+        only — same rule as qos_health): the active mesh shape, the
+        residual TP fraction the policy achieves at the decode shape
+        (1.0 = the f≈1 layout tools/tp_projection.py prices), whether
+        the KV pool is mesh-sharded, and the kv_pool_mesh_fallback flag
+        — a pool that silently fell back dense must be visible."""
+        if self.mesh is None:
+            return None
+        from ..parallel.sharding import residual_fraction
+
+        return {
+            "mesh": {a: int(s) for a, s in self.mesh.shape.items()},
+            "devices": int(self.mesh.size),
+            "residual_tp_fraction": residual_fraction(
+                self.mesh, self.batch_size, self.model_cfg.dim),
+            "pool_sharded": bool(self._use_pool),
+            "kv_pool_mesh_fallback": bool(self._kv_pool_mesh_fallback),
+        }
 
     def kv_pool_health(self) -> Optional[dict]:
         """Cheap pool view for /health (never stats() — same rule as
@@ -2381,9 +2467,21 @@ class BatchedJaxEngine(JaxEngine):
         g = self._grammar
         if g.version != self._grammar_version:
             version, tc, ok, nxt = g.snapshot_tables()
-            self._gram_tc_d = jnp.asarray(tc)
-            self._gram_ok_d = jnp.asarray(ok)
-            self._gram_next_d = jnp.asarray(nxt)
+            if self.mesh is not None:
+                # Pinned REPLICATED on the mesh (ISSUE 14): the stacked
+                # tables are per-profile host truth every shard's mask
+                # gather reads in full — a partitioner-chosen layout
+                # would either reshard per dispatch or shard rows a
+                # gather then has to fetch cross-device mid-scan.
+                from ..parallel.sharding import replicate
+
+                self._gram_tc_d = replicate(tc, self.mesh)
+                self._gram_ok_d = replicate(ok, self.mesh)
+                self._gram_next_d = replicate(nxt, self.mesh)
+            else:
+                self._gram_tc_d = jnp.asarray(tc)
+                self._gram_ok_d = jnp.asarray(ok)
+                self._gram_next_d = jnp.asarray(nxt)
             self._grammar_version = version
         return self._gram_tc_d, self._gram_ok_d, self._gram_next_d
 
@@ -2805,6 +2903,7 @@ class BatchedJaxEngine(JaxEngine):
             # — delta-mirrored into Prometheus at scrape time
             # (Metrics.observe_kv_pool) and summarized in /health.
             "kv_pool": self.kv_pool_health(),
+            "sharding": self.sharding_health(),
             "queue_rejections": self._rejections,
             "max_queue_depth": self.max_queue_depth,
             "tokens_per_sec_window": tok_window / self.TOKEN_RATE_WINDOW_SECS,
@@ -4393,7 +4492,7 @@ class BatchedJaxEngine(JaxEngine):
                     corrupt_d = shard_tokens(corrupt_d, self.mesh)
         packed_d = self._run_chunk(
             bucket, force, corrupt_d,
-            jnp.asarray(self._tables) if self._use_pool else None,
+            self._tables_d(self._tables) if self._use_pool else None,
             spec=spec)
         snapshot = [
             s.req if s is not None and not s.exhausted else None

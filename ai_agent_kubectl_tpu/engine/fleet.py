@@ -1204,6 +1204,32 @@ class EngineFleet:
         agg["radix"] = radix if radix_seen else None
         return agg
 
+    def sharding_health(self) -> dict:
+        """Fleet view of the replicas' sharding config (ISSUE 14):
+        replicas run one config, so the mesh/fraction/pool fields pass
+        through from the first reporting replica; the loud-fallback
+        flag is OR-ed — ANY replica silently serving the dense ladder
+        under a requested pool must surface at the fleet level."""
+        agg: dict = {}
+        fallback = False
+        for rep in self.replicas:
+            fn = getattr(rep.engine, "sharding_health", None)
+            if not callable(fn):
+                continue
+            try:
+                s = fn() or None
+            except Exception:   # pragma: no cover - stopped replica
+                continue
+            if not s:
+                continue
+            fallback = fallback or bool(s.get("kv_pool_mesh_fallback"))
+            if not agg:
+                agg = dict(s)
+        if not agg:
+            return {}
+        agg["kv_pool_mesh_fallback"] = fallback
+        return agg
+
     def grammar_health(self) -> dict:
         """Fleet rollup of the replicas' grammar views (ISSUE 11):
         forced/masked/dead-end totals sum; the compiled-grammar
@@ -1502,6 +1528,10 @@ class EngineFleet:
         # acceptance re-derived from the sums.
         if any(s.get("spec") for s in replica_stats):
             agg["spec"] = self.spec_health() or None
+        # Sharding (ISSUE 14): one config fleet-wide — pass-through
+        # with the kv_pool_mesh_fallback flag OR-ed across replicas.
+        if any(s.get("sharding") for s in replica_stats):
+            agg["sharding"] = self.sharding_health() or None
         fleet = self.fleet_health()
         fleet["replicas"] = per_replica
         agg["fleet"] = fleet

@@ -333,6 +333,26 @@ class JaxEngine:
             )
             self.dtype = jnp.float32
 
+    def sharding_health(self) -> Optional[dict]:
+        """Cheap sharding view for /health (ISSUE 14): mesh shape,
+        device count, and the residual TP fraction at this engine's
+        decode shape. The single-sequence engine decodes B=1 (the
+        residual can't batch-shard), has no pool and therefore no
+        fallback to report; the batched engine overrides with the pool
+        flags."""
+        if self.mesh is None:
+            return None
+        from ..parallel.sharding import residual_fraction
+
+        return {
+            "mesh": {a: int(s) for a, s in self.mesh.shape.items()},
+            "devices": int(self.mesh.size),
+            "residual_tp_fraction": residual_fraction(
+                self.mesh, 1, self.model_cfg.dim),
+            "pool_sharded": False,
+            "kv_pool_mesh_fallback": False,
+        }
+
     @staticmethod
     def _to_host_async(arr) -> None:
         """Start the device→host copy of ``arr`` without blocking. The

@@ -171,6 +171,62 @@ def _pool_gather(leaf, tables, n_pages: int):
                      + leaf.shape[2:])
 
 
+# ------------------------------------------------- residual sharding
+#
+# f≈1 residual-path TP sharding (ISSUE 14): with weights Megatron-split
+# over ``model``, the classic layout replicates the [B, S, d] residual
+# on every TP shard — norms, RoPE epilogues, residual adds and the
+# sampling scratch then run tp× redundantly, which is exactly the
+# (1−f)·residual term tools/tp_projection.py prices. Pinning the
+# residual batch-sharded over data×model at the sites below makes XLA
+# fuse each row-parallel GEMM's all-reduce into a reduce-scatter at its
+# output (plus one all-gather at the next column-parallel input): the
+# elementwise segments between GEMMs run 1/tp-sized per shard and the
+# collective count stays 2 fused pairs per layer — the projection's
+# priced model. ``parallel/sharding.py::residual_spec`` owns the
+# policy (and the pipe/expert/divisibility gates).
+
+
+def _shard_residual(mesh, x: jnp.ndarray) -> jnp.ndarray:
+    """Pin the [B, S, d] residual to the f≈1 layout (no-op when the
+    policy doesn't apply to this mesh/shape). The named_scope is what
+    lets obs/attribution.py bill the fused collectives XLA materializes
+    at this boundary as the ``all_reduce`` category."""
+    if mesh is None:
+        return x
+    from ..parallel.sharding import residual_spec
+
+    spec = residual_spec(mesh, x.shape)
+    if spec is None:
+        return x
+    from jax.sharding import NamedSharding
+
+    with jax.named_scope("all_reduce"):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec))
+
+
+def _shard_logits(mesh, logits: jnp.ndarray) -> jnp.ndarray:
+    """Pin [B, S, vocab] logits vocab-sharded over ``model`` (the LM
+    head's natural output layout) so the head output and the sampling
+    chain's vocab-sized scratch shard instead of replicating — the
+    lm_head_sampling slice of the f≈1 residual. Sampling semantics are
+    untouched: ``sample_tokens_seeded`` runs the same program over the
+    sharded operand and draws the identical token (the byte-identity
+    suites are the tripwire)."""
+    if mesh is None:
+        return logits
+    from ..parallel.sharding import logits_spec
+
+    spec = logits_spec(mesh, logits.shape[-1])
+    if spec is None:
+        return logits
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(
+        logits, NamedSharding(mesh, spec))
+
+
 # -------------------------------------------------------------- blocks
 
 def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
@@ -298,12 +354,25 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
             if attn_impl == "paged" and S == 1 and not is_q:
                 # TPU fast path: the block-table pallas kernel reads only
                 # each slot's live pages straight from the pool — no
-                # gathered copy ever materializes.
-                from ..ops.paged_attention import paged_decode_attention_pool
+                # gathered copy ever materializes. Under a >1 model axis
+                # the kernel runs shard_mapped with Q and KV heads split
+                # together (the pool shards on the KV-head axis, so each
+                # shard holds whole KV groups — ISSUE 14); XLA can't
+                # auto-partition a pallas_call.
+                if mesh is not None and mesh.shape["model"] > 1:
+                    from ..ops.paged_attention import \
+                        paged_decode_attention_pool_sharded
 
-                attn = paged_decode_attention_pool(
-                    q[:, 0], layer_k, layer_v, positions[:, 0],
-                    block_tables, page_size=page)[:, None]
+                    attn = paged_decode_attention_pool_sharded(
+                        q[:, 0], layer_k, layer_v, positions[:, 0],
+                        block_tables, mesh, page_size=page)[:, None]
+                else:
+                    from ..ops.paged_attention import \
+                        paged_decode_attention_pool
+
+                    attn = paged_decode_attention_pool(
+                        q[:, 0], layer_k, layer_v, positions[:, 0],
+                        block_tables, page_size=page)[:, None]
             elif is_q:
                 attn = dense_attention_quant(
                     q,
@@ -324,12 +393,13 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
                 else:
                     attn = dense_attention(q, k_ctx, v_ctx, mask)
         with jax.named_scope("o_proj"):
-            h = h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
+            h = _shard_residual(
+                mesh, h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"]))
         with jax.named_scope("mlp"):
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
             mlp = (_moe_mlp(cfg, lp, x, mesh, token_mask, moe_impl)
                    if cfg.is_moe else _dense_mlp(cfg, lp, x))
-        return h + mlp, layer_k, layer_v
+        return _shard_residual(mesh, h + mlp), layer_k, layer_v
 
     # Write this chunk's K/V into the cache at its absolute positions.
     # (scatter; positions are per-slot absolute indices). Dead rows
@@ -376,13 +446,14 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
                     mask,
                 )
         with jax.named_scope("o_proj"):
-            h = h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
+            h = _shard_residual(
+                mesh, h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"]))
 
         with jax.named_scope("mlp"):
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
             mlp = (_moe_mlp(cfg, lp, x, mesh, token_mask, moe_impl)
                    if cfg.is_moe else _dense_mlp(cfg, lp, x))
-        return h + mlp, layer_k, layer_v
+        return _shard_residual(mesh, h + mlp), layer_k, layer_v
     else:
         with jax.named_scope("kv_write"):
             layer_k = layer_k.at[batch_idx, w_pos].set(
@@ -471,13 +542,14 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
         with jax.named_scope("attention"):
             attn = dense_attention(q, k_ctx, v_ctx, mask)
     with jax.named_scope("o_proj"):
-        h = h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
+        h = _shard_residual(
+            mesh, h + qmatmul(attn.reshape(B, S, H * hd), lp["wo"]))
 
     with jax.named_scope("mlp"):
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, cfg.rms_offset)
         mlp = (_moe_mlp(cfg, lp, x, mesh, token_mask, moe_impl) if cfg.is_moe
                else _dense_mlp(cfg, lp, x))
-    return h + mlp, layer_k, layer_v
+    return _shard_residual(mesh, h + mlp), layer_k, layer_v
 
 
 # -------------------------------------------------------------- forward
@@ -541,14 +613,21 @@ def forward(
         if cfg.embed_scale:
             h = h * jnp.asarray(cfg.dim ** 0.5, h.dtype)
 
-    if block_tables is not None and mesh is not None:
-        # The pool is a shared structure across slots — the dense path's
-        # slots-over-``data`` sharding doesn't apply, and the pipe stage
-        # body has no table plumbing. The engine resolves KV_POOL under a
-        # mesh to the dense ladder before ever tracing this.
+    if (block_tables is not None and mesh is not None
+            and "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1):
+        # The pipelined stage body (parallel/pipeline.py) has no block-
+        # table plumbing — the engine resolves KV_POOL under a pipe mesh
+        # to the dense ladder before ever tracing this. TP/EP meshes
+        # compose (ISSUE 14): the pool cache shards on the KV-head axis
+        # (parallel/sharding.py::pool_cache_specs) and every access
+        # routes through the same table indirection as single-chip.
         raise NotImplementedError(
-            "block-paged KV does not compose with a serving mesh yet "
-            "(ROADMAP item 4); use the dense KV ladder")
+            "block-paged KV does not compose with a pipe mesh axis "
+            "(no table plumbing in the stage body); use the dense "
+            "KV ladder")
+    # f≈1 residual sharding starts at the embedding output — the scan
+    # carry then stays in the sharded layout across every layer.
+    h = _shard_residual(mesh, h)
     if mesh is not None and "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
         # Pipeline-parallel serving: the layer stack (params and KV cache
         # sharded over ``pipe`` on the layer axis, parallel/sharding.py)
@@ -591,6 +670,10 @@ def forward(
             logits = tied_head(h, params["embed"])
         else:
             logits = qmatmul(h, params["lm_head"])
+        # Keep the head's output in its vocab-sharded layout through the
+        # sampling chain (f≈1: the [B, 256k] f32 scratch never
+        # replicates; no-op off-mesh or when vocab doesn't divide).
+        logits = _shard_logits(mesh, logits)
 
     if block_tables is not None:
         # Pool mode: lengths are per-SLOT host truth (the scheduler's

@@ -44,16 +44,24 @@ import json
 import os
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_ID = "decode-step-attribution/v1"
+SCHEMA_ID = "decode-step-attribution/v2"
 
 #: category order is presentation order; "gaps" is computed (window −
 #: device-busy union), everything else from span durations.
+#: v2 (ISSUE 14) adds ``all_reduce``: the fused TP collectives
+#: (reduce-scatter at the row-parallel GEMM outputs + all-gather at the
+#: column-parallel inputs — the ``all_reduce`` named_scope in
+#: models/transformer.py) were previously lumped into data_movement, so
+#: the sharded step's comm time was invisible to
+#: ``tools/attribute_step.py --check`` and tp_projection could never
+#: reconcile its priced all-reduce term against a measurement.
 CATEGORIES = (
     "weight_gemms",        # qkv/o/mlp/moe projections + embedding read
     "attention",           # score/probs dots over the live KV span
     "lm_head_sampling",    # 256k-vocab head projection + sampling chain
     "kv_write_splice",     # per-layer KV scatter + admission splices
     "norm_rope_residual",  # layernorms, RoPE, residual adds
+    "all_reduce",          # TP collectives fused into the GEMM outputs
     "data_movement",       # copies, transposes, converts, layout changes
     "other_device",        # device-busy spans nothing above matched
     "gaps",                # device idle inside the capture window
@@ -62,8 +70,10 @@ CATEGORIES = (
 #: scope-path keywords (from the jax.named_scope annotations), checked in
 #: order — first hit wins. "attn_norm"/"mlp_norm" must land in norms, so
 #: the norm rule precedes the weight-GEMM rule that would match their
-#: enclosing "mlp" scope.
+#: enclosing "mlp" scope; the all_reduce scope precedes everything that
+#: could match the constraint's enclosing o_proj/mlp scopes.
 _SCOPE_RULES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("all_reduce", ("all_reduce",)),
     ("lm_head_sampling", ("lm_head", "sampling")),
     ("kv_write_splice", ("kv_write", "kv_splice", "splice")),
     ("attention", ("attention", "flash", "paged", "ring")),
@@ -74,15 +84,20 @@ _SCOPE_RULES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
 )
 
 #: HLO op-name fallbacks for spans with no scope metadata (bare fusion
-#: names, infeed/copy ops XLA inserts itself).
+#: names, infeed/copy ops XLA inserts itself). Collective ops bill to
+#: all_reduce (the comm category), never data_movement — partitioner-
+#: emitted collectives don't always inherit the constraint's scope.
 _HLO_RULES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    # comm first: "reduce-scatter" must never match the kv rule's bare
+    # "scatter".
+    ("all_reduce", ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute", "collective")),
     ("kv_write_splice", ("scatter", "dynamic-update-slice",
                          "dynamic_update_slice")),
     ("lm_head_sampling", ("rng", "sort", "top-k", "topk")),
     ("data_movement", ("copy", "transpose", "bitcast", "convert",
                        "reshape", "concatenate", "broadcast", "tuple",
-                       "infeed", "outfeed", "all-reduce", "all-gather",
-                       "collective", "slice", "pad", "iota")),
+                       "infeed", "outfeed", "slice", "pad", "iota")),
     ("weight_gemms", ("dot", "convolution", "gemm", "matmul")),
 )
 
@@ -224,7 +239,7 @@ def attribute_trace(trace_dir: str, steps: int, *,
 
     ``steps`` = decode steps executed inside the capture (reps ×
     chunk_len); per-step numbers divide by it. Returns the artifact dict
-    (schema ``decode-step-attribution/v1``), NOT yet validated — callers
+    (schema ``decode-step-attribution/v2``), NOT yet validated — callers
     run ``validate_attribution`` so a parse bug can't silently ship a
     malformed artifact.
     """

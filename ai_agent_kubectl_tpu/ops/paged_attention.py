@@ -201,6 +201,62 @@ def paged_decode_attention_pool(
     return out
 
 
+def paged_decode_attention_pool_sharded(
+    q: jnp.ndarray,            # [N, H, hd]
+    k: jnp.ndarray,            # [n_blocks, page, KV, hd]
+    v: jnp.ndarray,
+    positions: jnp.ndarray,    # [N]
+    block_tables: jnp.ndarray,  # [N, max_pages]
+    mesh,
+    *,
+    page_size: int = 128,
+) -> jnp.ndarray:
+    """Mesh-aware pool kernel dispatch (ISSUE 14): XLA can't
+    auto-partition a ``pallas_call``, so under a >1 ``model`` axis the
+    block-table kernel runs shard_mapped with Q and KV heads split
+    together over ``model`` — the pool shards on the KV-head axis
+    (parallel/sharding.py::pool_cache_specs), so each shard holds whole
+    KV groups and the kernel's local G = H_local/KV_local stays the
+    true grouping. Positions and tables are replicated (they are
+    per-slot host truth). Falls back to the unsharded call when the
+    head counts don't divide the axis (the gather/dense path serves
+    those meshes instead — engine startup picks it)."""
+    tp = mesh.shape["model"] if mesh is not None else 1
+    H, KV = q.shape[1], k.shape[2]
+    if tp <= 1:
+        return paged_decode_attention_pool(q, k, v, positions,
+                                           block_tables,
+                                           page_size=page_size)
+    if KV % tp or H % tp:
+        raise ValueError(
+            f"pool paged kernel needs KV ({KV}) and H ({H}) divisible "
+            f"by the model axis ({tp}); engine startup resolves such "
+            f"meshes to the gather path")
+    import jax.sharding as jsh
+
+    from ..parallel.compat import shard_map
+
+    P_ = jsh.PartitionSpec
+
+    def _local(ql, kl, vl, pos, tbl):
+        return paged_decode_attention_pool(ql, kl, vl, pos, tbl,
+                                           page_size=page_size)
+
+    return shard_map(
+        _local, mesh=mesh,
+        in_specs=(P_(None, "model", None),
+                  P_(None, None, "model", None),
+                  P_(None, None, "model", None),
+                  P_(None), P_(None, None)),
+        out_specs=P_(None, "model", None),
+        axis_names=set(mesh.axis_names),
+        # pallas_call can't express per-axis varying metadata for the
+        # VMA checker; the specs above are the contract (same rule as
+        # the dense-path shard_map in models/transformer.py).
+        check_vma=False,
+    )(q, k, v, positions, block_tables)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("page_size", "scale", "interpret"),

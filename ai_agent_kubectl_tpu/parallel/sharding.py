@@ -25,7 +25,7 @@ Llama-3-70B (8 KV heads → KV sharded 8-way) without special cases.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -112,6 +112,80 @@ def cache_specs(cfg: ModelConfig) -> Dict[str, P]:
     return {"k": kv, "v": kv, "lengths": P("data")}
 
 
+def pool_cache_specs(cfg: ModelConfig) -> Dict[str, P]:
+    """Block-paged pool KVCache sharding: [L, n_blocks, page, KV, hd]
+    shards on the KV-head axis over ``model`` exactly like dense KV —
+    decode attention stays fully local per TP shard until the wo reduce.
+    The block axis NEVER shards: blocks are a shared structure across
+    slots (any slot's table may map any block), so slots-over-``data``
+    does not apply — the engine falls back to the dense ladder on
+    meshes with a >1 data/pipe/seq axis (engine/batcher.py,
+    ``kv_pool_mesh_fallback``)."""
+    kv = P(None, None, None, "model", None)
+    return {"k": kv, "v": kv, "lengths": P()}
+
+
+def residual_spec(mesh: Mesh, shape: tuple) -> Optional[P]:
+    """Where the [B, S, d] residual's TP factor lands under f≈1
+    residual-path sharding (ISSUE 14): the batch axis when data×model
+    divides B (the decode shape — norms, RoPE epilogues, residual adds
+    and sampling scratch then run 1/tp-sized per shard, and XLA fuses
+    the row-parallel GEMM all-reduce into a reduce-scatter at its
+    output plus one all-gather at the next column-parallel input), else
+    the sequence axis (prefill's B==1), else None — the mesh keeps the
+    classic replicated-residual Megatron layout there.
+
+    Gated off pipe/expert meshes: the pipeline stage body owns its own
+    activation layout, and the EP all-to-all dispatch re-shards tokens
+    over ``expert`` itself."""
+    if (mesh is None or "model" not in mesh.axis_names
+            or mesh.shape["model"] <= 1 or mesh.shape["pipe"] > 1
+            or mesh.shape["expert"] > 1):
+        return None
+    B, S = shape[0], shape[1]
+    batch = sanitize_spec(mesh, P(("data", "model"),), (B,))
+    if batch[0] is not None:
+        return P(("data", "model"), None, None)
+    seq = sanitize_spec(mesh, P("model"), (S,))
+    if S > 1 and seq[0] is not None:
+        d_ax = ("data",) if B % max(1, mesh.shape["data"]) == 0 \
+            and mesh.shape["data"] > 1 else None
+        return P(d_ax[0] if d_ax else None, "model", None)
+    return None
+
+
+def logits_spec(mesh: Mesh, vocab: int) -> Optional[P]:
+    """[B, S, vocab] logits sharding under f≈1: the vocab axis over
+    ``model`` (the LM head is vocab-sharded, so the head's output never
+    materializes replicated and the sampling chain's vocab-sized
+    scratch shards with it). None when the vocab doesn't divide or the
+    residual policy is off for this mesh."""
+    if (mesh is None or "model" not in mesh.axis_names
+            or mesh.shape["model"] <= 1 or mesh.shape["pipe"] > 1
+            or mesh.shape["expert"] > 1):
+        return None
+    if vocab % mesh.shape["model"]:
+        return None
+    return P(None, None, "model")
+
+
+def residual_fraction(mesh: Optional[Mesh], batch: int, dim: int) -> float:
+    """The TP-shardable residual fraction f the active policy achieves
+    at the decode shape [batch, 1, dim] — 1.0 when the residual
+    batch-shards over data×model (the tp_projection.py f≈1 row), else
+    0.0 (classic replicated residual). Surfaced in /health's sharding
+    section so the operator can see whether the serving config actually
+    hits the priced f."""
+    if mesh is None:
+        return 0.0
+    spec = residual_spec(mesh, (batch, 1, dim))
+    if spec is None:
+        return 0.0
+    first = spec[0]
+    group = first if isinstance(first, tuple) else (first,)
+    return 1.0 if "model" in group else 0.0
+
+
 def token_spec() -> P:
     """[B, S] token/position arrays: batch over data."""
     return P("data", None)
@@ -181,6 +255,42 @@ def shard_cache(cache, mesh: Mesh, cfg: ModelConfig):
             NamedSharding(mesh, sanitize_spec(mesh, specs["lengths"], cache.lengths.shape)),
         ),
     )
+
+
+def shard_pool_cache(cache, mesh: Mesh, cfg: ModelConfig):
+    """device_put a block-paged pool KVCache onto the mesh: KV heads
+    over ``model``, everything else replicated (``pool_cache_specs``).
+    QuantKV leaves place the int8 payload with the full spec and the
+    per-(block, page-row, head) scales with the same spec minus the
+    trailing head_dim axis — same zip rule as ``shard_cache``."""
+    from ..models.transformer import KVCache
+    from ..ops.quant import QuantKV
+
+    specs = pool_cache_specs(cfg)
+
+    def _put_kv(block, spec):
+        def put(a):
+            return jax.device_put(
+                a, NamedSharding(mesh, sanitize_spec(mesh, spec, a.shape)))
+
+        if isinstance(block, QuantKV):
+            return QuantKV(q=put(block.q), s=put(block.s))
+        return put(block)
+
+    return KVCache(
+        k=_put_kv(cache.k, specs["k"]),
+        v=_put_kv(cache.v, specs["v"]),
+        lengths=jax.device_put(
+            cache.lengths, NamedSharding(mesh, P())),
+    )
+
+
+def replicate(arr, mesh: Mesh):
+    """device_put an array fully replicated on the mesh — block tables
+    and grammar tables ride dispatches as plain arguments and must be
+    committed to the replicated layout their compiled programs expect
+    (an uncommitted array would at best reshard per dispatch)."""
+    return jax.device_put(arr, NamedSharding(mesh, P()))
 
 
 def shard_tokens(tokens, mesh: Mesh):

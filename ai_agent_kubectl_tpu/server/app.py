@@ -1003,6 +1003,12 @@ async def handle_health(request: web.Request) -> web.Response:
     kph = getattr(svc.engine, "kv_pool_health", None)
     if callable(kph):
         kv_pool = kph() or None
+    # Sharding (ISSUE 14): mesh shape, residual TP fraction, pool-
+    # sharded + mesh-fallback flags — cheap host attributes, same rule.
+    sharding = None
+    shh = getattr(svc.engine, "sharding_health", None)
+    if callable(shh):
+        sharding = shh() or None
     # Grammar (ISSUE 11): compiled-grammar hash, state count, forced/
     # masked totals — cheap host counters, same rule as the rest.
     grammar = None
@@ -1034,6 +1040,7 @@ async def handle_health(request: web.Request) -> web.Response:
         qos=qos,
         slo=slo,
         kv_pool=kv_pool,
+        sharding=sharding,
         grammar=grammar,
         spec=spec,
         rollout=rollout,
@@ -1299,6 +1306,11 @@ async def handle_metrics(request: web.Request) -> web.Response:
         # sharing/COW/radix-hit counters — same delta-mirror pattern.
         if stats.get("kv_pool"):
             svc.metrics.observe_kv_pool(stats["kv_pool"])
+        # Tensor-parallel serving (ISSUE 14): mesh device count,
+        # residual TP fraction, and the kv_pool_mesh_fallback flag —
+        # gauges sampled at scrape time.
+        if stats.get("sharding"):
+            svc.metrics.observe_sharding(stats["sharding"])
         # Grammar-constrained decoding (ISSUE 11): forced/masked token
         # + dead-end counters — same delta-mirror pattern.
         if stats.get("grammar"):

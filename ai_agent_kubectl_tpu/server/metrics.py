@@ -194,6 +194,33 @@ class Metrics:
         )
         self._kv_pool_seen = {"shared": 0, "cow": 0, "hit": 0, "miss": 0}
 
+        # Tensor-parallel serving (ISSUE 14, parallel/sharding.py):
+        # the active mesh size, the residual TP fraction the f≈1 policy
+        # achieves at the decode shape (1.0 = the layout
+        # tools/tp_projection.py prices), and the loud-fallback flag
+        # for a KV pool forced back to the dense ladder by a
+        # data/pipe/seq mesh axis. Gauges sampled at scrape time from
+        # stats()["sharding"].
+        self.mesh_devices = Gauge(
+            "mesh_devices",
+            "Devices in the active serving mesh (0 = single device)",
+            registry=r,
+        )
+        self.sharding_residual_fraction = Gauge(
+            "sharding_residual_fraction",
+            "Residual TP-shardable fraction f achieved by the active "
+            "sharding policy at the decode shape (1.0 = full f~1 "
+            "residual-path sharding)",
+            registry=r,
+        )
+        self.kv_pool_mesh_fallback = Gauge(
+            "kv_pool_mesh_fallback",
+            "1 when KV_POOL was requested but the mesh forced the "
+            "dense KV ladder (data/pipe/seq axis > 1) — a silent "
+            "dense fallback must be visible",
+            registry=r,
+        )
+
         # Decode-pipeline metrics (ISSUE 4: device-side termination +
         # deep chunk pipelining). Occupancy/config are gauges sampled at
         # scrape; the waste/chunk counters are cumulative scheduler totals
@@ -589,6 +616,16 @@ class Metrics:
             if total > seen[key]:
                 counter.inc(total - seen[key])
                 seen[key] = total
+
+    def observe_sharding(self, sharding: dict) -> None:
+        """Mirror the engine's sharding view (stats()["sharding"],
+        ISSUE 14) into Prometheus at scrape time — plain gauges (all
+        three are config-derived states, not cumulative totals)."""
+        self.mesh_devices.set(sharding.get("devices", 0))
+        self.sharding_residual_fraction.set(
+            sharding.get("residual_tp_fraction", 0.0))
+        self.kv_pool_mesh_fallback.set(
+            1 if sharding.get("kv_pool_mesh_fallback") else 0)
 
     def observe_containment(self, stats: dict) -> None:
         """Delta-mirror the engine supervisor's containment totals

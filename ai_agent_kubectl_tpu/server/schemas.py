@@ -108,9 +108,17 @@ class HealthResponse(BaseModel):
     # Block-paged KV pool + radix prefix sharing (ISSUE 10,
     # engine/kv_pool.py): block counts by state (free/live/cached),
     # sharing + copy-on-write totals, and the radix tree's hit/miss
-    # token counters. None = dense-KV engine (KV_POOL=false, a serving
-    # mesh, or the single-sequence/fake/openai paths).
+    # token counters. None = dense-KV engine (KV_POOL=false, a mesh
+    # with a >1 data/pipe/seq axis, or the single-sequence/fake/openai
+    # paths). TP/EP meshes serve the pool (ISSUE 14).
     kv_pool: Optional[Dict[str, Any]] = None
+    # Tensor-parallel serving (ISSUE 14, parallel/sharding.py): the
+    # active mesh shape + device count, the residual TP fraction the
+    # f≈1 policy achieves at the decode shape, whether the KV pool is
+    # mesh-sharded, and the kv_pool_mesh_fallback flag (a requested
+    # pool that fell back to the dense ladder must be visible). None =
+    # no serving mesh.
+    sharding: Optional[Dict[str, Any]] = None
     # Grammar-constrained decoding (ISSUE 11, constrain/): the active
     # profile, compiled-grammar hash + state/class counts, forced vs
     # masked token totals, and dead ends by cause. None = GRAMMAR_DECODE

@@ -527,6 +527,118 @@ async def phase_pipe7b(batch_size: int, max_seq: int, kv_quant: str,
     }
 
 
+async def phase_tp7b(batch_size: int, max_seq: int, mesh: str,
+                     model: str = "gemma-7b-it",
+                     chunk_len: int = 8) -> dict:
+    """One rung of the ISSUE 14 TP sweep: the MEASURED sharded decode
+    step — pool under the mesh, f≈1 residual sharding, fused
+    collectives — on whatever devices exist (the driver forces the
+    8-virtual-device CPU mesh via JAX_PLATFORMS/XLA_FLAGS on a
+    single-chip host; a real v5e-8 runs it on ICI). Times the
+    engine-identical decode chunk directly (the attribution harness
+    precedent: a step measurement needs the program, not live traffic)
+    and bills its all-reduce share with obs/attribution.py, so the
+    artifact carries step-time AND comm share per rung —
+    ``tools/tp_projection.py --measured-json`` re-prices from exactly
+    these numbers."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.models.config import get_config
+    from ai_agent_kubectl_tpu.obs.attribution import attribute_trace
+    from ai_agent_kubectl_tpu.parallel.mesh import MeshConfig
+
+    want = MeshConfig.parse(mesh).n_devices
+    if len(jax.devices()) < want:
+        return {"skipped": f"mesh {mesh} wants {want} devices, "
+                           f"have {len(jax.devices())}"}
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = get_config(model)
+    tok, _ = make_tokenizer(cfg)
+    log(f"bench: tp7b rung bs={batch_size} mesh={mesh} model={model} "
+        f"({'tpu' if on_tpu else 'cpu virtual mesh'})")
+    eng = BatchedJaxEngine(
+        cfg,
+        tokenizer=tok,
+        dtype="bfloat16" if on_tpu else "float32",
+        quant="int8" if on_tpu else "",
+        kv_quant="int8" if on_tpu else "",
+        max_seq_len=max_seq,
+        prefill_buckets=(64, 128),
+        attn_impl="dense" if not on_tpu else "auto",
+        prefix_cache=False,
+        mesh_shape=mesh,
+        batch_size=batch_size,
+        chunk_len=chunk_len,
+        kv_pool=True,
+    )
+    t0 = time.monotonic()
+    await eng.start()
+    log(f"bench: tp7b engine ready in {time.monotonic() - t0:.1f}s")
+    try:
+        sh = eng.sharding_health() or {}
+        bucket = eng._kv_buckets[0]
+        force = jnp.ones((batch_size,), jnp.bool_)
+        # _tables only exists when the pool serves — a dp/pp/sp mesh
+        # falls back to the dense ladder (the rung still measures it,
+        # flagged by kv_pool_mesh_fallback in the artifact).
+        tables_d = (eng._tables_d(eng._tables) if eng._use_pool
+                    else None)
+
+        def run(n: int):
+            packed = None
+            for _ in range(n):
+                packed = eng._run_chunk(bucket, force, eng._no_corrupt_d,
+                                        tables_d, spec=False)
+            packed.block_until_ready()
+
+        run(1)                       # settle layouts
+        reps = 4
+        t0 = time.monotonic()
+        run(reps)
+        step_ms = (time.monotonic() - t0) * 1e3 / (reps * chunk_len)
+
+        # All-reduce share: trace 2 chunks, bill with the category
+        # table (the v2 all_reduce category is the point — comm time
+        # must be accounted, not lumped into "other").
+        ar_ms = share = None
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                with jax.profiler.trace(td):
+                    run(2)
+                att = attribute_trace(td, 2 * chunk_len)
+            cats = {c["name"]: c["ms_per_step"]
+                    for c in att["categories"]}
+            ar_ms = cats.get("all_reduce")
+            if ar_ms is not None and step_ms > 0:
+                share = round(ar_ms / step_ms, 4)
+        except Exception as e:   # trace is best-effort per rung
+            log(f"bench: tp7b attribution failed ({e}); "
+                f"step time only")
+        tp = max(1, want)
+        return {
+            "model": model,
+            "mesh": mesh,
+            "backend": "tpu" if on_tpu else "cpu-virtual",
+            "bs": batch_size,
+            "kv_bucket": bucket,
+            "chunk_len": chunk_len,
+            "step_ms": round(step_ms, 3),
+            "tok_s_chip": round(batch_size / step_ms * 1e3 / tp, 1),
+            "allreduce_ms": (round(ar_ms, 4)
+                             if ar_ms is not None else None),
+            "allreduce_share": share,
+            "pool_sharded": sh.get("pool_sharded"),
+            "residual_tp_fraction": sh.get("residual_tp_fraction"),
+            "kv_pool_mesh_fallback": sh.get("kv_pool_mesh_fallback"),
+        }
+    finally:
+        await eng.stop()
+
+
 async def phase_paged7b(batch_size: int, max_seq: int, kv_quant: str,
                         kv_pool: bool, pool_envelope_bs: int = 0,
                         agent_loop: bool = False,
@@ -783,17 +895,20 @@ async def phase_2b() -> dict:
 # Orchestrator (no jax import here — the tunnel TPU is exclusive)
 # ---------------------------------------------------------------------------
 
-def _run_phase(args: list, timeout: float, script: str | None = None) -> dict | None:
+def _run_phase(args: list, timeout: float, script: str | None = None,
+               env: dict | None = None) -> dict | None:
     """Run one phase subprocess; parse its final stdout line as JSON.
 
     Also used by tools/bench_paged_gqa.py (pass ``script``) so there is one
     hardened spawn-and-parse path: timeouts and non-JSON stdout are logged
-    failures (None), not tracebacks."""
+    failures (None), not tracebacks. ``env`` overrides the child
+    environment (the tp7b rungs force the 8-virtual-device CPU mesh)."""
     cmd = [sys.executable, script or os.path.abspath(__file__)] + args
     log(f"bench: spawn {' '.join(args)}")
     try:
         proc = subprocess.run(
-            cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=timeout)
+            cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=timeout,
+            env=env)
     except subprocess.TimeoutExpired:
         log(f"bench: phase {args} timed out after {timeout:.0f}s")
         return None
@@ -977,6 +1092,40 @@ def orchestrate() -> dict:
         if spec_sweep:
             extra7["spec_sweep"] = spec_sweep
 
+        # TP sweep (ISSUE 14): the MEASURED sharded step at bs 48/96/192
+        # on the 8-virtual-device CPU mesh (a single-chip bench host has
+        # no 8-way ICI; the virtual mesh measures the real programs —
+        # collectives, pool sharding, f≈1 layout — with CPU arithmetic
+        # under them, so step-time RATIOS and the all-reduce share are
+        # meaningful, absolute tok/s is not chip truth). A v5e-8 host
+        # runs the same rungs on ICI and its numbers ARE chip truth.
+        # `tools/tp_projection.py --measured-json` re-prices from this
+        # artifact. TP_SWEEP_MODEL scales the model down (the 7B's f32
+        # host footprint may not fit small bench hosts).
+        tp_model = os.environ.get("TP_SWEEP_MODEL", "gemma-7b-it")
+        tp_env = dict(os.environ)
+        if os.environ.get("TP_SWEEP_ON_DEVICE", "") != "1":
+            tp_env["JAX_PLATFORMS"] = "cpu"
+            tp_env["XLA_FLAGS"] = (
+                tp_env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+        tp_rungs = []
+        for bs in (48, 96, 192):
+            rt = _run_phase(
+                ["--phase", "tp7b", "--bs", str(bs), "--mesh", "tp=8",
+                 "--max-seq", "256", "--model", tp_model],
+                timeout=3600, env=tp_env)
+            if rt is not None and "skipped" in rt:
+                log(f"bench: tp7b rung bs={bs} skipped ({rt['skipped']})")
+                continue
+            if rt is not None:
+                tp_rungs.append(rt)
+            else:
+                log(f"bench: tp7b rung bs={bs} failed; continuing")
+        if tp_rungs:
+            extra7["tp_sweep"] = {"mesh": "tp=8", "model": tp_model,
+                                  "rungs": tp_rungs}
+
     rmoe = _run_phase(["--phase", "moe"], timeout=2400)
 
     r2 = _run_phase(["--phase", "2b"], timeout=2400)
@@ -1008,7 +1157,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", choices=["7b", "2b", "moe", "attr7b",
                                         "pipe7b", "paged7b",
-                                        "grammar7b", "spec7b"],
+                                        "grammar7b", "spec7b", "tp7b"],
                     default=None)
     ap.add_argument("--bs", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=256)
@@ -1021,6 +1170,8 @@ def main() -> None:
     ap.add_argument("--grammar", choices=["on", "off"], default="off")
     ap.add_argument("--spec", choices=["on", "off"], default="off")
     ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument("--mesh", default="tp=8")
+    ap.add_argument("--model", default="gemma-7b-it")
     ns = ap.parse_args()
 
     if ns.phase == "7b":
@@ -1044,6 +1195,10 @@ def main() -> None:
             phase_spec7b(ns.bs, ns.max_seq, ns.kv_quant,
                          ns.spec == "on", ns.spec_k,
                          ns.grammar == "on", ns.chunk_len))
+    elif ns.phase == "tp7b":
+        result = asyncio.run(
+            phase_tp7b(ns.bs, ns.max_seq, ns.mesh, ns.model,
+                       ns.chunk_len))
     elif ns.phase == "attr7b":
         result = phase_attr7b(ns.bs, ns.max_seq, ns.kv_quant)
     elif ns.phase == "2b":

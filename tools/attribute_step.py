@@ -3,9 +3,11 @@
 Runs the engine-identical donated decode chunk under ``jax.profiler.trace``
 and prints/writes the per-op-category table that must SUM to the measured
 step — weight GEMMs / attention / LM-head+sampling / KV write+splice /
-norms+RoPE / data movement / gaps — via ``obs/attribution.py`` (which
-bills device spans by the ``jax.named_scope`` annotations in
-models/transformer.py and engine/sampling.py).
+norms+RoPE / all-reduce (the fused TP collectives, schema v2 — so a
+sharded step's comm time is accounted, not lumped into "other") / data
+movement / gaps — via ``obs/attribution.py`` (which bills device spans
+by the ``jax.named_scope`` annotations in models/transformer.py and
+engine/sampling.py).
 
 On the bench chip (the r5 geometry whose 33.3 ms step was ~19 ms
 unattributed):

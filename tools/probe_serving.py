@@ -131,6 +131,7 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
     if consumed:
         log(f"  wasted steps / consumed chunk {wasted / consumed:>8.2f}")
     print_containment_summary(gauges)
+    print_mesh_summary(gauges)
     print_kv_pool_summary(gauges)
     print_grammar_summary(gauges)
     print_fleet_summary(gauges)
@@ -173,6 +174,23 @@ def print_containment_summary(gauges: Dict[str, float]) -> None:
     log(f"  slot health trips total     {trips or 0:>8.0f}")
     log(f"  replayed tokens total       "
         f"{gauges.get('replayed_tokens_total', 0.0):>8.0f}")
+
+
+def print_mesh_summary(gauges: Dict[str, float]) -> None:
+    """Tensor-parallel serving (ISSUE 14) from the same /metrics
+    scrape: mesh size, the residual TP fraction the active policy
+    achieves (1.0 = the f≈1 layout tp_projection prices), and whether
+    a requested KV pool silently fell back to the dense ladder."""
+    devices = gauges.get("mesh_devices", 0.0)
+    if not devices:
+        return      # single-device serving (no mesh)
+    frac = gauges.get("sharding_residual_fraction", 0.0)
+    fallback = gauges.get("kv_pool_mesh_fallback", 0.0)
+    log("probe[mesh]: tensor-parallel serving")
+    log(f"  mesh devices                {devices:>8.0f}")
+    log(f"  residual TP fraction (f)    {frac:>8.2f}")
+    log(f"  kv pool mesh fallback       "
+        f"{'YES (dense ladder!)' if fallback else 'no':>8}")
 
 
 def print_kv_pool_summary(gauges: Dict[str, float]) -> None:

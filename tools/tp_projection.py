@@ -114,11 +114,70 @@ def render(a, out: dict) -> str:
     return "\n".join(lines)
 
 
+def implied_f(a, step_tp_ms: float, bs: int, ar_ms: float) -> float:
+    """Solve the model's residual TP-fraction f back out of a MEASURED
+    sharded step: step_tp = weights/tp + attn·scale/tp + residual·((1−f)
+    + f/tp) + ar  ⇒  f = (1 − residual_tp/residual) · tp/(tp−1).
+    Clamped to [0, 1] — measurement noise can push the division past
+    either end. tp=1 is degenerate (nothing shards): f is reported 0."""
+    if a.tp <= 1:
+        return 0.0
+    scale = bs / a.bs
+    residual = (a.step_ms - a.weights_ms - a.attn_ms) \
+        * ((1 - a.g) + a.g * scale)
+    residual_tp = step_tp_ms - a.weights_ms / a.tp \
+        - a.attn_ms * scale / a.tp - ar_ms
+    if residual <= 0:
+        return 0.0
+    return max(0.0, min(1.0, (1.0 - residual_tp / residual)
+                        * a.tp / (a.tp - 1)))
+
+
+def render_measured(a, rungs: list) -> str:
+    """The measured-step section (ISSUE 14): once the sharded engine
+    exists, the projection re-prices from ITS step — tok/s/chip is
+    arithmetic on the measurement, and the model only back-solves the
+    implied f so projection and implementation converge on one number.
+    ``rungs`` = [{bs, step_ms, allreduce_ms?}, ...] — the bench
+    ``--phase tp7b`` sweep (driver artifact ``gemma_7b.tp_sweep``)."""
+    lines = [
+        "",
+        f"Measured TP={a.tp} step (re-priced from the sharded engine, "
+        f"not the dense-step-derived model):",
+        "",
+        "| bs | measured step ms | all-reduce ms | implied f "
+        "| tok/s/chip |",
+        "|---|---|---|---|---|",
+    ]
+    for r in rungs:
+        bs = int(r["bs"])
+        step = float(r["step_ms"])
+        ar = float(r.get("allreduce_ms") or 0.0)
+        f = implied_f(a, step, bs, ar)
+        lines.append(
+            f"| {bs} | {step:.2f} | {ar:.2f} | {f:.2f} "
+            f"| **{bs / step * 1e3 / a.tp:.0f}** |")
+    return "\n".join(lines)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--attribution", default=None,
                     help="decode-step-attribution JSON; overrides step/"
                          "weights/attention defaults with its measurements")
+    ap.add_argument("--measured-json", default=None,
+                    help="bench artifact (BENCH_rNN.json or a bare "
+                         "--phase tp7b dict) carrying the measured "
+                         "sharded-step sweep (gemma_7b.tp_sweep); adds "
+                         "the measured re-pricing section")
+    ap.add_argument("--measured-step", type=float, default=None,
+                    help="one measured sharded step in ms (with "
+                         "--measured-bs) instead of --measured-json")
+    ap.add_argument("--measured-bs", type=int, default=192)
+    ap.add_argument("--measured-allreduce", type=float, default=None,
+                    help="measured all-reduce ms within the sharded "
+                         "step (attribution category; default: the "
+                         "priced ring model)")
     ap.add_argument("--step-ms", type=float, default=33.3,
                     help="measured single-chip step (r5 trace, bs=48)")
     ap.add_argument("--weights-ms", type=float, default=11.6)
@@ -178,6 +237,35 @@ def main() -> int:
 
     out = project(a)
     print(render(a, out))
+
+    rungs = []
+    if a.measured_json:
+        with open(a.measured_json) as f:
+            bench = json.load(f)
+        sweep = bench
+        for key in ("gemma_7b", "tp_sweep"):
+            if isinstance(sweep, dict) and key in sweep:
+                sweep = sweep[key]
+        if isinstance(sweep, dict):
+            rungs = [r for r in sweep.get("rungs", ())
+                     if isinstance(r, dict) and "step_ms" in r]
+        if not rungs:
+            print(f"# no tp_sweep rungs in {a.measured_json}",
+                  file=sys.stderr)
+    elif a.measured_step is not None:
+        rungs = [{"bs": a.measured_bs, "step_ms": a.measured_step,
+                  "allreduce_ms": a.measured_allreduce}]
+    if rungs:
+        for r in rungs:
+            # Only an ABSENT measurement falls back to the priced ring
+            # model — a measured 0.0 (attribution billed no comm) must
+            # stay 0.0, or the "measured" table silently mixes in
+            # priced values.
+            if r.get("allreduce_ms") is None:
+                r["allreduce_ms"] = a.layers * 2 * allreduce_ms(
+                    a.tp, int(r["bs"]) * a.dim * a.dtype_bytes,
+                    a.ici_gbps, a.ici_latency_us)
+        print(render_measured(a, rungs))
     return 0
 
 
