@@ -434,6 +434,48 @@ class ServiceConfig:
     # Success-rate objective the error budget is priced from: at 0.99,
     # 1% of samples may breach before burn rate 1.0.
     slo_objective: float = 0.99             # SLO_OBJECTIVE
+    # --- perf-regression sentinel (ISSUE 15; obs/steptime.py) ---
+    # Baseline envelope file for the step-time sentinel: JSON with a
+    # step_time_ms table ({phase: {bucket|"default": ms}}), seeded from
+    # the BENCH_r*.json numbers of record (PERF_BASELINES.json in the
+    # repo root). Empty = no file; every digest then self-calibrates
+    # from its first SENTINEL_MIN_SAMPLES samples. A set-but-unloadable
+    # path refuses to boot.
+    perf_baselines: str = ""                # PERF_BASELINES
+    # Master switch for the always-on step-time digests + breach
+    # detection (the digests are a bounded ring per (phase, bucket) —
+    # the cost of leaving this on is one deque append per chunk cycle).
+    sentinel_enable: bool = True            # SENTINEL_ENABLE
+    # Samples kept per (phase, bucket) digest (the p50/p95/p99 window).
+    sentinel_window: int = 256              # SENTINEL_WINDOW
+    # Breach rule: recent p99 > factor x baseline trips the sentinel.
+    sentinel_factor: float = 2.0            # SENTINEL_FACTOR
+    # Samples required before a digest may breach (also the
+    # self-calibration window when no file baseline covers the key).
+    sentinel_min_samples: int = 16          # SENTINEL_MIN_SAMPLES
+    # Incident-watcher evaluation period (seconds): a background task
+    # polls the cheap health views for firing triggers this often.
+    # 0 = no background watcher (triggers still evaluate at /metrics
+    # scrapes and /debug/incidents reads).
+    sentinel_eval_secs: float = 2.0         # SENTINEL_EVAL_SECS
+    # --- incident capture (ISSUE 15; obs/incidents.py) ---
+    # How many incident bundles the /debug/incidents ring retains.
+    incident_ring: int = 8                  # INCIDENT_RING
+    # Per-trigger cooldown: within it further firings of the same
+    # trigger are counted suppressed but assemble NOTHING — capture
+    # overhead can never cascade during the incident it is observing.
+    incident_cooldown_secs: float = 60.0    # INCIDENT_COOLDOWN_SECS
+    # Fast-window SLO burn at or above this fires the slo_fast_burn
+    # trigger. 0 disables the burn trigger.
+    incident_burn_threshold: float = 2.0    # INCIDENT_BURN_THRESHOLD
+    # Attach a rate-limited jax.profiler capture of this many seconds
+    # to each new bundle (jax engines only). 0 = off (the default —
+    # captures are tens of MB and cost real device time).
+    incident_profile_secs: float = 0.0      # INCIDENT_PROFILE_SECS
+    # Optional canary-vs-stable step-time verdict in the weight-rollout
+    # promotion gate: the canary rolls back when its decode p95 reaches
+    # this multiple of the stable cohort's. 0 = off; >= 1 otherwise.
+    rollout_steptime_gate: float = 0.0      # ROLLOUT_STEPTIME_GATE
     # Debug-endpoint token: when set, /debug/* additionally requires
     # X-Debug-Token (profiler captures and request timelines are
     # operator-facing, not client-facing). Unset = only API-key auth
@@ -484,6 +526,55 @@ class ServiceConfig:
         if self.slo_ttft_ms < 0:
             raise ValueError(
                 f"SLO_TTFT_MS must be >= 0, got {self.slo_ttft_ms}")
+        # Perf-regression sentinel + incident knobs (ISSUE 15): a
+        # typo'd factor/window or an unloadable baselines file must
+        # refuse to boot, not silently disarm the regression trigger.
+        if self.sentinel_window < 8:
+            raise ValueError(
+                f"SENTINEL_WINDOW must be >= 8 samples, "
+                f"got {self.sentinel_window}")
+        if self.sentinel_factor < 1.0:
+            raise ValueError(
+                f"SENTINEL_FACTOR must be >= 1 (a factor below 1 would "
+                f"trip on every healthy step), got {self.sentinel_factor}")
+        if self.sentinel_min_samples < 1:
+            raise ValueError(
+                f"SENTINEL_MIN_SAMPLES must be >= 1, "
+                f"got {self.sentinel_min_samples}")
+        if self.sentinel_eval_secs < 0:
+            raise ValueError(
+                f"SENTINEL_EVAL_SECS must be >= 0 (0 = scrape-driven "
+                f"only), got {self.sentinel_eval_secs}")
+        if self.incident_ring < 1:
+            raise ValueError(
+                f"INCIDENT_RING must be >= 1, got {self.incident_ring}")
+        if self.incident_cooldown_secs < 0:
+            raise ValueError(
+                f"INCIDENT_COOLDOWN_SECS must be >= 0, "
+                f"got {self.incident_cooldown_secs}")
+        if self.incident_burn_threshold < 0:
+            raise ValueError(
+                f"INCIDENT_BURN_THRESHOLD must be >= 0 (0 disables), "
+                f"got {self.incident_burn_threshold}")
+        if not 0.0 <= self.incident_profile_secs <= 30.0:
+            raise ValueError(
+                f"INCIDENT_PROFILE_SECS must be in [0, 30] (captures "
+                f"are tens of MB each), got {self.incident_profile_secs}")
+        if self.rollout_steptime_gate != 0.0 \
+                and self.rollout_steptime_gate < 1.0:
+            raise ValueError(
+                f"ROLLOUT_STEPTIME_GATE must be 0 (off) or >= 1 (a "
+                f"factor below 1 would roll back every healthy canary), "
+                f"got {self.rollout_steptime_gate}")
+        if self.perf_baselines:
+            from .obs.steptime import load_baselines
+
+            try:
+                load_baselines(self.perf_baselines)
+            except (OSError, ValueError, KeyError) as e:
+                raise ValueError(
+                    f"PERF_BASELINES {self.perf_baselines!r} failed to "
+                    f"load: {e}") from e
         # KV pool knobs (ISSUE 10): the page must divide the 128-token
         # kv-limit tile (kv buckets are 128-tiled, so every attention
         # gather width must be a whole page count) and the prefill-chunk
@@ -707,6 +798,21 @@ class ServiceConfig:
             slo_ttft_ms=_env_float("SLO_TTFT_MS", 5000.0),
             slo_windows=_env_str("SLO_WINDOWS", "300,3600") or "300,3600",
             slo_objective=_env_float("SLO_OBJECTIVE", 0.99),
+            perf_baselines=_env_str("PERF_BASELINES", "") or "",
+            sentinel_enable=_env_bool("SENTINEL_ENABLE", True),
+            sentinel_window=_env_int("SENTINEL_WINDOW", 256),
+            sentinel_factor=_env_float("SENTINEL_FACTOR", 2.0),
+            sentinel_min_samples=_env_int("SENTINEL_MIN_SAMPLES", 16),
+            sentinel_eval_secs=_env_float("SENTINEL_EVAL_SECS", 2.0),
+            incident_ring=_env_int("INCIDENT_RING", 8),
+            incident_cooldown_secs=_env_float(
+                "INCIDENT_COOLDOWN_SECS", 60.0),
+            incident_burn_threshold=_env_float(
+                "INCIDENT_BURN_THRESHOLD", 2.0),
+            incident_profile_secs=_env_float(
+                "INCIDENT_PROFILE_SECS", 0.0),
+            rollout_steptime_gate=_env_float(
+                "ROLLOUT_STEPTIME_GATE", 0.0),
             debug_token=_env_str("DEBUG_TOKEN", None),
             drain_timeout_secs=_env_float("DRAIN_TIMEOUT_SECS", 10.0),
             compile_cache_dir=os.getenv(

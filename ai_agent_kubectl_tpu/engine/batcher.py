@@ -52,6 +52,9 @@ from ..obs.ledger import (CLASS_DELIVERED, CLASS_DRAFT_REJECTED,
                           CLASS_QUARANTINE_BURN, CLASS_REPLAYED,
                           CLASS_WASTED_MASKED, GoodputLedger)
 from ..obs.slo import SLO_QUEUE_WAIT, SLO_TTFT, SloEngine
+from ..obs.steptime import (PHASE_DECODE, PHASE_PREFILL,
+                            PHASE_SPEC_VERIFY, StepTimeSentinel,
+                            prefill_bucket)
 from ..obs.trace import Trace, current_trace
 from ..ops.quant import (kv_broadcast_rows, kv_set_slots, kv_slot_update,
                          kv_tokens, kv_update_slice)
@@ -736,6 +739,11 @@ class BatchedJaxEngine(JaxEngine):
                  slo_ttft_ms: float = 0.0,
                  slo_windows: tuple = (300, 3600),
                  slo_objective: float = 0.99,
+                 sentinel_enable: bool = True,
+                 sentinel_window: int = 256,
+                 sentinel_factor: float = 2.0,
+                 sentinel_min_samples: int = 16,
+                 perf_baselines=None,
                  faults=None,
                  **kwargs):
         super().__init__(*args, **kwargs)
@@ -894,6 +902,25 @@ class BatchedJaxEngine(JaxEngine):
         self._slo = SloEngine(
             {SLO_TTFT: slo_ttft_ms, SLO_QUEUE_WAIT: slo_interactive_ms},
             objective=slo_objective, windows=tuple(slo_windows))
+        # Perf-regression sentinel (ISSUE 15, obs/steptime.py): one
+        # sample per decode-chunk cycle (the dispatch-to-dispatch
+        # interval while the pipe stays busy — it covers exactly one
+        # consume, so device slowdowns, fetch stalls, AND scheduler
+        # stalls all stretch it) keyed by (phase, kv bucket), plus one
+        # per admission prefill. ``perf_baselines`` is a loaded table
+        # or a PERF_BASELINES file path; absent an entry, each digest
+        # self-calibrates from its first samples.
+        self._steptime = StepTimeSentinel(
+            enabled=sentinel_enable, window=sentinel_window,
+            factor=sentinel_factor, min_samples=sentinel_min_samples,
+            baselines=perf_baselines)
+        # (t, phase, bucket, tokens) of the previous chunk dispatch +
+        # whether a consume happened since — the pair that gates a
+        # dispatch interval into a step-time sample. A depth-1 pipe
+        # never satisfies the busy condition (no chunk in flight at
+        # dispatch) and simply yields no samples.
+        self._steptime_pending = None
+        self._steptime_consumed = False
         self._preemptions = 0          # cumulative preempt-and-replay count
         self._preempted_tokens = 0     # generated tokens carried across them
         self._preempt_times: collections.deque = collections.deque(maxlen=512)
@@ -1048,6 +1075,11 @@ class BatchedJaxEngine(JaxEngine):
             slo_ttft_ms=cfg.slo_ttft_ms,
             slo_windows=cfg.slo_window_list,
             slo_objective=cfg.slo_objective,
+            sentinel_enable=cfg.sentinel_enable,
+            sentinel_window=cfg.sentinel_window,
+            sentinel_factor=cfg.sentinel_factor,
+            sentinel_min_samples=cfg.sentinel_min_samples,
+            perf_baselines=cfg.perf_baselines or None,
             faults=faults,
         )
 
@@ -2958,7 +2990,18 @@ class BatchedJaxEngine(JaxEngine):
             # (Metrics.observe_spec) and summarized in /health's spec
             # section.
             "spec": self.spec_health(),
+            # Perf-regression sentinel (ISSUE 15): per-(phase, bucket)
+            # step-time digests + breach verdicts — mirrored into the
+            # step_time_seconds{phase,bucket,quantile} gauges at scrape
+            # time (Metrics.observe_steptime) and watched by the
+            # service-level incident triggers.
+            "steptime": self._steptime.snapshot(),
         }
+
+    def steptime_health(self) -> dict:
+        """Cheap step-time sentinel view for /health and the incident
+        watcher (a bounded-ring sort per digest, never stats())."""
+        return self._steptime.snapshot()
 
     #: finish timestamps older than this don't feed the drain-rate
     #: estimate — after an idle hour the first shed must not price
@@ -4332,6 +4375,13 @@ class BatchedJaxEngine(JaxEngine):
             req.t_first0 = now
         slot.t_decode0 = now
         slot.prefill_ms = (now - slot.t_admit) * 1000.0
+        # Sentinel prefill sample: admission → first-token consume (the
+        # same quantity slot.prefill_ms reports), keyed by the prefill
+        # bucket covering the prompt so label cardinality stays bounded.
+        self._steptime.note(
+            PHASE_PREFILL,
+            prefill_bucket(slot.n_prompt, self.prefill_buckets),
+            now - slot.t_admit, tokens=slot.n_prompt, now=now)
         if req.trace is not None:
             req.trace.event("engine: first token")
         if first_tok in self.model_cfg.eos_ids:
@@ -4467,6 +4517,22 @@ class BatchedJaxEngine(JaxEngine):
         # the capacity sweep stay conservative.
         needed = max(s.pos for s in active_slots) + ct
         bucket = next(b for b in self._kv_buckets if b >= needed)
+        # Step-time sentinel sample: the interval since the previous
+        # dispatch, provided a consume happened in between AND the pipe
+        # never emptied (an idle gap between requests must not read as
+        # a 10-second step). One such interval covers exactly one chunk
+        # cycle — ct device steps — so the stored unit is ms/step.
+        now = time.monotonic()
+        pend = self._steptime_pending
+        if (pend is not None and self._steptime_consumed
+                and any(e[0] == "chunk" for e in self._inflight)):
+            t0, phase0, bucket0, toks0 = pend
+            self._steptime.note(phase0, bucket0, now - t0,
+                                steps=toks0[0], tokens=toks0[1], now=now)
+        self._steptime_pending = (
+            now, PHASE_SPEC_VERIFY if spec else PHASE_DECODE, bucket,
+            (ct, ct * len(active_slots)))
+        self._steptime_consumed = False
         # decode:nan fault seam: normally the cached all-False mask; a
         # drill swaps in a mask that NaNs the target slot's logits inside
         # the jitted chunk so the REAL device-side health detection (and
@@ -4651,6 +4717,7 @@ class BatchedJaxEngine(JaxEngine):
         fetch_s = time.monotonic() - t_fetch
         self._fetch_samples.append(fetch_s)
         self._chunks_consumed += 1
+        self._steptime_consumed = True   # arms the next dispatch's sample
         self._last_n_alive = res.n_alive
         self._chunk_log.append({
             "t": time.time(), "event": "consume", "n_alive": res.n_alive,

@@ -30,6 +30,9 @@ from ..obs.ledger import (CLASS_DELIVERED, CLASS_DRAFT_REJECTED,
                           CLASS_QUARANTINE_BURN, CLASS_REPLAYED,
                           CLASS_WASTED_MASKED, GoodputLedger)
 from ..obs.slo import SLO_QUEUE_WAIT, SLO_TTFT, SloEngine
+from ..obs.steptime import (PHASE_DECODE, PHASE_PREFILL,
+                            PHASE_SPEC_VERIFY, StepTimeSentinel,
+                            prefill_bucket)
 from ..obs.trace import current_trace
 from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
                           CAUSE_SLOT_HEALTH, PROBATION_CLEAN_CHUNKS,
@@ -252,6 +255,11 @@ class FakeChunkedEngine:
                  spec_decode: bool = False,
                  spec_draft_k: int = 4,
                  spec_fake_miss: int = 3,
+                 sentinel_enable: bool = True,
+                 sentinel_window: int = 256,
+                 sentinel_factor: float = 2.0,
+                 sentinel_min_samples: int = 16,
+                 perf_baselines=None,
                  max_seq_len: int = 256,
                  faults=None,
                  weights_version: str = "fake-0",
@@ -297,6 +305,19 @@ class FakeChunkedEngine:
         self._slo = SloEngine(
             {SLO_TTFT: slo_ttft_ms, SLO_QUEUE_WAIT: slo_interactive_ms},
             objective=slo_objective, windows=tuple(slo_windows))
+        # Perf-regression sentinel (ISSUE 15) — the SAME StepTimeSentinel
+        # the batcher runs, fed by the same dispatch-interval scheme, so
+        # the whole sentinel → trigger → incident chain runs in tier-1:
+        # a chunk-path delay fault stretches dispatch intervals exactly
+        # like a slow device. The fake's μs-scale steps mean only the
+        # self-calibrated envelope is meaningful here; decode samples
+        # key by the batch rung (the fake has no KV bucket ladder).
+        self._steptime = StepTimeSentinel(
+            enabled=sentinel_enable, window=sentinel_window,
+            factor=sentinel_factor, min_samples=sentinel_min_samples,
+            baselines=perf_baselines)
+        self._steptime_pending = None
+        self._steptime_consumed = False
         self._preemptions = 0
         self._preempted_tokens = 0
         self._preempt_times: deque = deque(maxlen=512)
@@ -327,6 +348,10 @@ class FakeChunkedEngine:
         self._chunks_consumed = 0
         self._chunks_pruned = 0
         self._last_n_alive = 0
+        # Chunk-event ring (mirror of the batcher's): /debug/chunks and
+        # the incident bundles read it, so the evidence chain runs in
+        # tier-1 on the fake too.
+        self._chunk_log: deque = deque(maxlen=512)
         # Block-paged KV pool mirror (ISSUE 10): the SAME BlockPool /
         # RadixCache objects and the SAME kv_pool.map_prefix admission
         # path the batcher runs — the fake's KV is fictional (scripted
@@ -790,7 +815,12 @@ class FakeChunkedEngine:
             "slo": self._slo.snapshot(),
             "grammar": self.grammar_health(),
             "spec": self.spec_health(),
+            "steptime": self._steptime.snapshot(),
         }
+
+    def steptime_health(self) -> dict:
+        """Cheap step-time sentinel view (mirror of the batcher's)."""
+        return self._steptime.snapshot()
 
     # ------------------------------------------ telemetry plane (ISSUE 8)
 
@@ -1061,6 +1091,7 @@ class FakeChunkedEngine:
             if req.cancel.is_set():
                 continue
             self._credit_preempt_wait(req)
+            t_adm0 = time.monotonic()
             lane = req.lane if req.lane in LANES else LANE_INTERACTIVE
             counts[lane] += 1
             if req.t_submit:
@@ -1192,6 +1223,14 @@ class FakeChunkedEngine:
             if not self.device_termination:
                 slot.dev_active = True
             self._slots[i] = slot
+            # Sentinel prefill sample (mirror of the batcher's
+            # admission→first-token measurement; the fake's "prefill"
+            # is host work, μs-scale — the self-calibrated envelope
+            # makes it a meaningful regression signal regardless).
+            self._steptime.note(
+                PHASE_PREFILL, prefill_bucket(len(req.prompt_ids)),
+                time.monotonic() - t_adm0,
+                tokens=len(req.prompt_ids))
             if req.export is not None:
                 req.export.ids = list(slot.emitted)
             req.out_queue.put_nowait(
@@ -1222,6 +1261,12 @@ class FakeChunkedEngine:
         The EMITTED tokens are the scripted stream either way (the
         exact-match-verification guarantee), so spec on/off transcripts
         are byte-identical by construction here too."""
+        if self.faults is not None:
+            # Chunk-path fault seam (mirror of the batcher's): a delay/
+            # hang here stalls the dispatch loop exactly like a slow
+            # device dispatch — the step-time sentinel drill's
+            # injection point.
+            self.faults.check("chunk")
         if (self._spec_active() and self.faults is not None
                 and self.faults.draft_die()):
             # draft:die — the draft engine is gone; degrade to plain
@@ -1230,6 +1275,21 @@ class FakeChunkedEngine:
             self._spec_live = False
             self._spec_degraded += 1
         spec = self._spec_active() and self.device_termination
+        # Step-time sentinel sample (mirror of the batcher's gating): a
+        # dispatch interval counts only when a consume happened since
+        # the previous dispatch AND the pipe never emptied.
+        now = time.monotonic()
+        pend = self._steptime_pending
+        if pend is not None and self._steptime_consumed and self._inflight:
+            t0, phase0, bucket0, (steps0, toks0) = pend
+            self._steptime.note(phase0, bucket0, now - t0,
+                                steps=steps0, tokens=toks0, now=now)
+        n_live = sum(s is not None for s in self._slots)
+        ct0 = self._chunk_tokens if spec else self.chunk_len
+        self._steptime_pending = (
+            now, PHASE_SPEC_VERIFY if spec else PHASE_DECODE,
+            self.batch_size, (ct0, ct0 * n_live))
+        self._steptime_consumed = False
         N = self.batch_size
         C = self._chunk_tokens if spec else self.chunk_len
         toks = np.zeros((N, C), np.int32)
@@ -1329,6 +1389,11 @@ class FakeChunkedEngine:
                             accepted=accepted if spec else None)
         self._inflight.append(("chunk", packed, snapshot, C, spec))
         self._chunks_dispatched += 1
+        self._chunk_log.append({
+            "t": time.time(), "event": "dispatch",
+            "slots": sum(s is not None for s in snapshot),
+            "pipe": len(self._inflight),
+        })
 
     def _spec_slot_rows(self, i: int, slot: _FakeSlot, toks, done,
                         lengths, health, drafted, accepted,
@@ -1409,6 +1474,11 @@ class FakeChunkedEngine:
         self._fetches += 1          # the single fetch per chunk
         res = unpack_chunk(packed, self.batch_size, ct, spec=is_spec)
         self._chunks_consumed += 1
+        self._steptime_consumed = True   # arms the next dispatch's sample
+        self._chunk_log.append({
+            "t": time.time(), "event": "consume", "n_alive": res.n_alive,
+            "pipe": len(self._inflight),
+        })
         self._last_n_alive = res.n_alive
         # Speculative accounting (mirror of the batcher): acceptance
         # counters + the draft_rejected waste class, billed BEFORE the

@@ -59,6 +59,7 @@ from typing import AsyncIterator, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..obs import ledger as obs_ledger
 from ..obs import slo as obs_slo
+from ..obs import steptime as obs_steptime
 from ..obs.ledger import CLASS_HEDGE_LOSER, GoodputLedger
 from ..obs.trace import current_trace
 from ..server.breaker import OPEN, CircuitBreaker
@@ -1299,6 +1300,28 @@ class EngineFleet:
             if drafted else None)
         return agg
 
+    def steptime_health(self) -> dict:
+        """Fleet rollup of the replicas' step-time sentinel snapshots
+        (ISSUE 15): per-key digests merge worst-replica percentiles,
+        breaches union WITH replica attribution — a straggling replica
+        is exactly a breach naming its index while its siblings' stay
+        clean (obs/steptime.py merge_snapshots)."""
+        snaps: List[Optional[dict]] = []
+        seen = False
+        for rep in self.replicas:
+            fn = getattr(rep.engine, "steptime_health", None)
+            s = None
+            if callable(fn):
+                try:
+                    s = fn() or None
+                except Exception:   # pragma: no cover - stopped replica
+                    s = None
+            seen = seen or bool(s)
+            snaps.append(s)
+        if not seen:
+            return {}
+        return obs_steptime.merge_snapshots(snaps)
+
     def slo_health(self) -> dict:
         """Fleet rollup of the replicas' SLO burn snapshots: per-window
         counts sum, burn rates recompute from the sums (rates don't
@@ -1532,6 +1555,10 @@ class EngineFleet:
         # with the kv_pool_mesh_fallback flag OR-ed across replicas.
         if any(s.get("sharding") for s in replica_stats):
             agg["sharding"] = self.sharding_health() or None
+        # Step-time sentinel (ISSUE 15): per-replica digests merged
+        # with replica attribution on breaches.
+        if any(s.get("steptime") for s in replica_stats):
+            agg["steptime"] = self.steptime_health() or None
         fleet = self.fleet_health()
         fleet["replicas"] = per_replica
         agg["fleet"] = fleet

@@ -86,11 +86,13 @@ CAUSE_CANARY_DOWN = "canary_down"        # canary ejected / breaker open
 CAUSE_SWAP_FAILED = "swap_failed"        # replica died mid-swap
 CAUSE_CHECKPOINT_CORRUPT = "checkpoint_corrupt"  # rejected at load
 CAUSE_WARMUP_FAILED = "warmup_failed"    # rejoin/start failed post-swap
+CAUSE_STEPTIME_GATE = "steptime_gate"    # canary decode p95 regressed
 CAUSE_ABORTED = "aborted"                # operator POST /admin/rollout/abort
 ROLLBACK_CAUSES = (CAUSE_BURN_GATE, CAUSE_GOODPUT_GATE,
                    CAUSE_COUNTER_GATE, CAUSE_CANARY_DOWN,
                    CAUSE_SWAP_FAILED, CAUSE_CHECKPOINT_CORRUPT,
-                   CAUSE_WARMUP_FAILED, CAUSE_ABORTED)
+                   CAUSE_WARMUP_FAILED, CAUSE_STEPTIME_GATE,
+                   CAUSE_ABORTED)
 
 
 class RolloutError(RuntimeError):
@@ -196,6 +198,7 @@ class RolloutController:
                  canary_share: float = 0.1,
                  observe_secs: float = 60.0,
                  burn_gate: float = 2.0,
+                 steptime_gate: float = 0.0,
                  drain_secs: float = 10.0):
         # Clamp the canary share away from interactive-lane starvation:
         # at most half the fresh traffic may be steered at one replica,
@@ -206,6 +209,15 @@ class RolloutController:
         self.canary_share = min(max(float(canary_share), 0.01), 0.5)
         self.observe_secs = max(0.0, float(observe_secs))
         self.burn_gate = max(1.0, float(burn_gate))
+        # Optional canary-vs-stable STEP-TIME verdict (ISSUE 15): the
+        # canary rolls back when its decode/spec_verify p95 reaches
+        # this multiple of the stable cohort's on the same (phase,
+        # bucket) key (obs/steptime.py canary_vs_stable). 0 = off —
+        # the burn gate already catches latency the client can feel;
+        # this one catches "the canary is 30% slower per step" before
+        # any SLO breaches.
+        self.steptime_gate = (0.0 if steptime_gate <= 0
+                              else max(1.0, float(steptime_gate)))
         self.drain_secs = max(0.0, float(drain_secs))
         self.engine = engine
         self.state = STATE_IDLE
@@ -721,6 +733,20 @@ class RolloutController:
                 and c_burn >= self.burn_gate * max(1.0, s_burn or 0.0):
             detail.update(breach=True, cause=CAUSE_BURN_GATE)
             return detail
+        # 3b. Step-time gate (optional, ISSUE 15): canary-vs-stable
+        # decode p95 on matching (phase, bucket) keys — a per-step
+        # regression is visible long before enough requests breach an
+        # SLO to move the burn rate. No comparable key ⇒ no verdict.
+        if self.steptime_gate > 0:
+            from ..obs import steptime as obs_steptime
+
+            cmp = obs_steptime.canary_vs_stable(
+                self._safe_steptime(canary.engine),
+                [self._safe_steptime(rep.engine) for rep in stable])
+            detail["steptime"] = cmp
+            if cmp is not None and cmp["ratio"] >= self.steptime_gate:
+                detail.update(breach=True, cause=CAUSE_STEPTIME_GATE)
+                return detail
         # 4. Goodput gate: the canary's delivered fraction of ledger
         # steps since observe start vs stable's, once both cohorts have
         # a meaningful sample.
@@ -742,6 +768,16 @@ class RolloutController:
     @staticmethod
     def _safe_slo(eng) -> dict:
         fn = getattr(eng, "slo_health", None)
+        if not callable(fn):
+            return {}
+        try:
+            return fn() or {}
+        except Exception:   # pragma: no cover - stopped replica
+            return {}
+
+    @staticmethod
+    def _safe_steptime(eng) -> dict:
+        fn = getattr(eng, "steptime_health", None)
         if not callable(fn):
             return {}
         try:

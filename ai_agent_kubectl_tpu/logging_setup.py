@@ -17,6 +17,7 @@ import json
 import logging
 
 from .engine.qos import current_qos
+from .obs.incidents import current_incident_id
 from .obs.ledger import hash_tenant
 from .obs.trace import current_trace
 
@@ -39,6 +40,11 @@ class RequestIdFilter(logging.Filter):
         record.tenant = hash_tenant(qctx.tenant) if qctx is not None \
             else None
         record.lane = qctx.lane if qctx is not None else None
+        # Incident join (ISSUE 15): while an incident's stamp window is
+        # open, every line carries its id — the same join pattern as
+        # the hashed tenant, so a /debug/incidents bundle and a log
+        # grep meet on one key post-hoc.
+        record.incident_id = current_incident_id()
         return True
 
 
@@ -58,6 +64,10 @@ class JsonFormatter(logging.Formatter):
             # lines join against the goodput ledger's tenant table.
             "tenant": getattr(record, "tenant", None),
             "lane": getattr(record, "lane", None),
+            # Incident join (ISSUE 15): non-None while an incident's
+            # stamp window is open — grep for it to collect the lines
+            # around a /debug/incidents bundle.
+            "incident_id": getattr(record, "incident_id", None),
         }
         if record.exc_info:
             entry["exc_info"] = self.formatException(record.exc_info)

@@ -24,12 +24,24 @@ import jax, aiohttp, or prometheus_client. Three pieces:
 - ``obs.slo`` — multi-window (5m/1h) error-budget burn rates for TTFT
   and queue wait per lane, exported as ``slo_*`` gauges and a ``/health``
   section, and consumable by the QoS brownout controller.
+- ``obs.steptime`` — the perf-regression sentinel's digests: per-chunk
+  step time keyed by (phase, bucket), p50/p95/p99 gauges, trailing
+  tok/s per rung, and online breach detection against a boot-loaded
+  baseline envelope (``PERF_BASELINES``) or a self-calibrated one.
+- ``obs.incidents`` — anomaly-triggered incident capture: a firing
+  trigger (step-time breach, burn spike, quarantine/dead-end spike,
+  pool exhaustion, breaker open) assembles a bounded evidence bundle
+  into a ring behind ``/debug/incidents``, with per-trigger cooldowns.
 """
 
+from .incidents import TRIGGERS, IncidentManager, current_incident_id
 from .ledger import (LEDGER_CLASSES, WASTE_CLASSES, GoodputLedger,
                      hash_tenant)
 from .recorder import FlightRecorder
 from .slo import SLO_QUEUE_WAIT, SLO_TTFT, SloEngine, parse_slo_windows
+from .steptime import (PHASE_DECODE, PHASE_PREFILL, PHASE_SPEC_VERIFY,
+                       STEP_PHASES, StepTimeSentinel, load_baselines,
+                       prefill_bucket)
 from .trace import (PHASES, Trace, current_trace, new_request_id,
                     sanitize_request_id, trace_event, use_trace)
 
@@ -37,16 +49,26 @@ __all__ = [
     "PHASES",
     "LEDGER_CLASSES",
     "WASTE_CLASSES",
+    "PHASE_DECODE",
+    "PHASE_PREFILL",
+    "PHASE_SPEC_VERIFY",
     "SLO_QUEUE_WAIT",
     "SLO_TTFT",
+    "STEP_PHASES",
+    "TRIGGERS",
     "FlightRecorder",
     "GoodputLedger",
+    "IncidentManager",
     "SloEngine",
+    "StepTimeSentinel",
     "Trace",
+    "current_incident_id",
     "current_trace",
     "hash_tenant",
+    "load_baselines",
     "new_request_id",
     "parse_slo_windows",
+    "prefill_bucket",
     "sanitize_request_id",
     "trace_event",
     "use_trace",

@@ -24,10 +24,12 @@ Retry-After; API-key auth via ``X-API-Key`` (app.py:140-151), disabled when
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import hmac
+import json
 import logging
 import time
-from contextlib import nullcontext
+from contextlib import nullcontext, suppress
 from typing import Optional
 
 from aiohttp import web
@@ -40,8 +42,9 @@ from ..engine.protocol import (Engine, EngineOverloaded, EngineResult,
                                RequestQuarantined, TenantOverloaded)
 from ..engine.qos import classify, use_qos
 from ..engine.prompts import render_prompt
-from ..obs import (PHASES, FlightRecorder, Trace, current_trace,
-                   new_request_id, sanitize_request_id, use_trace)
+from ..obs import (PHASES, FlightRecorder, IncidentManager, Trace,
+                   current_trace, new_request_id, sanitize_request_id,
+                   use_trace)
 from ..obs import profiler as obs_profiler
 from .breaker import STATE_CODES, CircuitBreaker
 from .cache import CachedSingleFlight
@@ -136,6 +139,20 @@ class Service:
         # engine_tokens_per_sec gauge at scrape time (see WindowedRate).
         self.recorder = FlightRecorder(cfg.flight_recorder_size)
         self.token_rate = WindowedRate()
+        # Perf-regression sentinel (ISSUE 15): the incident manager
+        # watches the engine's cheap health views for firing triggers
+        # (step-time breach, burn spike, quarantine/dead-end spike,
+        # pool exhaustion, breaker open) and files bounded evidence
+        # bundles behind /debug/incidents. The config fingerprint rides
+        # every bundle so "what exactly was this server running" is
+        # answerable post-hoc (describe() is secret-free by contract).
+        self.incidents = IncidentManager(
+            ring=cfg.incident_ring,
+            cooldown_secs=cfg.incident_cooldown_secs,
+            burn_threshold=cfg.incident_burn_threshold)
+        self.config_fingerprint = hashlib.sha256(
+            json.dumps(cfg.describe(), sort_keys=True,
+                       default=repr).encode()).hexdigest()[:12]
         # QoS ring (ISSUE 7): the tenant→tier map is parsed once at
         # startup (a typo'd TENANT_TIERS already refused to boot in
         # ServiceConfig.__post_init__); the qos middleware classifies
@@ -177,6 +194,7 @@ class Service:
                 canary_share=cfg.rollout_canary_share,
                 observe_secs=cfg.rollout_observe_secs,
                 burn_gate=cfg.rollout_burn_gate,
+                steptime_gate=cfg.rollout_steptime_gate,
                 drain_secs=cfg.drain_timeout_secs,
             )
 
@@ -197,6 +215,97 @@ class Service:
             except Exception:  # pragma: no cover - defensive
                 pass
         return 1.0
+
+    # -------------------------------- perf sentinel / incidents (ISSUE 15)
+
+    def _engine_view(self, name: str) -> Optional[dict]:
+        """One cheap engine health view, or None (absent/failing) — the
+        incident plane must never take the serving path down."""
+        fn = getattr(self.engine, name, None)
+        if not callable(fn):
+            return None
+        try:
+            return fn() or None
+        except Exception:  # pragma: no cover - defensive
+            return None
+
+    def _quarantine_total(self) -> int:
+        """Cumulative terminal quarantines across every replica's
+        supervisor (cheap attribute reads — never stats(), which drains
+        samples owed to the /metrics scrape)."""
+        target = getattr(self.engine, "inner", self.engine)
+        engines = ([rep.engine for rep in target.replicas]
+                   if hasattr(target, "replicas") else [target])
+        total = 0
+        for eng in engines:
+            sup = getattr(eng, "supervisor", None)
+            if sup is not None:
+                total += sum(getattr(sup, "quarantined", {}).values())
+        return total
+
+    def _chunk_rings(self, limit: int = 64) -> dict:
+        """Per-replica tails of the scheduler chunk-event rings (the
+        /debug/chunks evidence, frozen into the bundle). Deque copies
+        retry on concurrent-mutation RuntimeError, same as the route."""
+        target = getattr(self.engine, "inner", self.engine)
+        engines = ([(str(rep.idx), rep.engine)
+                    for rep in target.replicas]
+                   if hasattr(target, "replicas")
+                   else [("0", target)])
+        out = {}
+        for key, eng in engines:
+            log = getattr(eng, "_chunk_log", None)
+            if log is None:
+                continue
+            events: list = []
+            for _ in range(5):
+                try:
+                    events = list(log)
+                    break
+                except RuntimeError:
+                    continue
+            out[key] = events[-limit:]
+        return out
+
+    def _incident_bundle(self) -> dict:
+        """Assemble one bounded evidence bundle: flight-recorder
+        snapshot, chunk rings, and every cheap health section, plus the
+        config fingerprint and weights version. Called by the incident
+        manager OUTSIDE its lock, at most once per trigger cooldown."""
+        return {
+            "weights_version": (str(getattr(self.engine,
+                                            "weights_version", "") or "")
+                                or None),
+            "config_fingerprint": self.config_fingerprint,
+            "breaker": self.breaker.state,
+            "flight_recorder": self.recorder.list(limit=32),
+            "chunks": self._chunk_rings(),
+            "ledger": self._engine_view("ledger_snapshot"),
+            "slo": self._engine_view("slo_health"),
+            "qos": self._engine_view("qos_health"),
+            "kv_pool": self._engine_view("kv_pool_health"),
+            "sharding": self._engine_view("sharding_health"),
+            "grammar": self._engine_view("grammar_health"),
+            "spec": self._engine_view("spec_health"),
+            "fleet": self._engine_view("fleet_health"),
+            "steptime": self._engine_view("steptime_health"),
+            "rollout": (self.rollout.health()
+                        if self.rollout is not None else None),
+        }
+
+    def check_incidents(self) -> list:
+        """One trigger-evaluation round (the background watcher, the
+        /metrics scrape, and /debug/incidents reads all share it —
+        cooldowns make redundant evaluation free). Returns NEW bundles."""
+        views = {
+            "steptime": self._engine_view("steptime_health"),
+            "slo": self._engine_view("slo_health"),
+            "kv_pool": self._engine_view("kv_pool_health"),
+            "grammar": self._engine_view("grammar_health"),
+            "breaker": self.breaker.state,
+            "quarantined_total": self._quarantine_total(),
+        }
+        return self.incidents.evaluate(views, self._incident_bundle)
 
     async def run_engine(self, coro_fn):
         """One engine call under the circuit breaker: fail fast while the
@@ -1026,6 +1135,14 @@ async def handle_health(request: web.Request) -> web.Response:
     # cheap controller counters, same rule as the rest. The fleet
     # section above carries each replica's weights_version too.
     rollout = svc.rollout.health() if svc.rollout is not None else None
+    # Perf-regression sentinel (ISSUE 15): step-time digest summary +
+    # breach state (cheap bounded-ring reads), and the incident ring's
+    # captured/suppressed totals.
+    steptime = None
+    sth = getattr(svc.engine, "steptime_health", None)
+    if callable(sth):
+        steptime = sth() or None
+    incidents = svc.incidents.snapshot()
     body = HealthResponse(
         status="healthy" if ready and breaker == "closed" else "degraded",
         engine=getattr(svc.engine, "name", "unknown"),
@@ -1044,6 +1161,8 @@ async def handle_health(request: web.Request) -> web.Response:
         grammar=grammar,
         spec=spec,
         rollout=rollout,
+        steptime=steptime,
+        incidents=incidents,
     )
     # The HTTP status tracks engine readiness alone: an open breaker with
     # the engine process alive still serves (fallback and/or cache), and
@@ -1200,6 +1319,75 @@ async def handle_debug_ledger(request: web.Request) -> web.Response:
     return web.json_response(snap)
 
 
+async def _attach_incident_profiles(app: web.Application, svc: Service,
+                                    bundles: list) -> None:
+    """Optionally attach a rate-limited jax.profiler capture to fresh
+    bundles (INCIDENT_PROFILE_SECS > 0, jax engines only). Serialized
+    against operator-requested captures via the same _tracing flag, and
+    bounded by the trigger cooldowns that bounded the bundles."""
+    secs = svc.cfg.incident_profile_secs
+    if secs <= 0 or not bundles:
+        return
+    import sys
+
+    if "jax" not in sys.modules:
+        return   # fake/openai deployment: nothing to profile
+    if app.get("_tracing"):
+        bundles[0]["profile"] = {"skipped": "capture already running"}
+        return
+    app["_tracing"] = True
+    try:
+        result = await obs_profiler.capture(secs)
+        bundles[0]["profile"] = result
+    except Exception as e:  # pragma: no cover - backend-dependent
+        bundles[0]["profile"] = {"error": str(e)}
+    finally:
+        app["_tracing"] = False
+
+
+async def handle_debug_incidents(request: web.Request) -> web.Response:
+    """GET /debug/incidents — the incident ring's newest-first index
+    (ISSUE 15). Each entry is a bounded evidence bundle an anomaly
+    trigger assembled automatically (step-time breach, SLO burn spike,
+    quarantine/dead-end spike, pool exhaustion, breaker open); fetch a
+    full bundle from /debug/incidents/{id}. Reading runs one trigger
+    evaluation first, so a freshly-tripped sentinel files its bundle on
+    the very request that comes looking for it."""
+    denied = _debug_forbidden(request)
+    if denied is not None:
+        return denied
+    svc: Service = request.app["service"]
+    try:
+        new = svc.check_incidents()
+        await _attach_incident_profiles(request.app, svc, new)
+    except Exception:   # pragma: no cover - defensive
+        logger.exception("incident evaluation failed")
+    return web.json_response({
+        **svc.incidents.snapshot(),
+        "incidents": svc.incidents.list(),
+    })
+
+
+async def handle_debug_incident_detail(request: web.Request
+                                       ) -> web.Response:
+    """GET /debug/incidents/{id} — one incident's full evidence bundle
+    (flight recorder, chunk rings, ledger/SLO/pool/spec health
+    snapshots, config fingerprint, weights version)."""
+    denied = _debug_forbidden(request)
+    if denied is not None:
+        return denied
+    svc: Service = request.app["service"]
+    iid = request.match_info["id"]
+    bundle = svc.incidents.get(iid)
+    if bundle is None:
+        return _json_error(
+            404,
+            f"incident {iid!r} not in the ring (keeps the newest "
+            f"{svc.incidents.ring_size}; is INCIDENT_RING large "
+            f"enough?)")
+    return web.json_response(bundle)
+
+
 def _rollout_unavailable(svc: Service) -> Optional[web.Response]:
     if svc.rollout is None:
         return _json_error(
@@ -1319,6 +1507,19 @@ async def handle_metrics(request: web.Request) -> web.Response:
         # the acceptance-ratio gauge — same delta-mirror pattern.
         if stats.get("spec"):
             svc.metrics.observe_spec(stats["spec"])
+        # Perf-regression sentinel (ISSUE 15): step_time_seconds
+        # quantile gauges + per-rung tok/s + the breach-trip counter.
+        if stats.get("steptime"):
+            svc.metrics.observe_steptime(stats["steptime"])
+    # Incident plane (ISSUE 15): a scrape is also a trigger-evaluation
+    # round (cooldowns make redundant evaluation free), so deployments
+    # with SENTINEL_EVAL_SECS=0 still capture incidents at scrape
+    # cadence; captured/suppressed totals delta-mirror by trigger.
+    try:
+        svc.check_incidents()
+    except Exception:   # pragma: no cover - defensive
+        logger.exception("incident evaluation failed at scrape")
+    svc.metrics.observe_incidents(svc.incidents.snapshot())
     # Weight rollout (ISSUE 13): state gauge + per-version replica
     # counts + rollbacks{cause} — the controller sits ABOVE the engine
     # seam, so it mirrors from its own health view, not stats().
@@ -1354,6 +1555,9 @@ def create_app(cfg: ServiceConfig, engine: Engine,
     app.router.add_get("/debug/requests/{id}", handle_debug_request_detail)
     app.router.add_get("/debug/chunks", handle_debug_chunks)
     app.router.add_get("/debug/ledger", handle_debug_ledger)
+    app.router.add_get("/debug/incidents", handle_debug_incidents)
+    app.router.add_get("/debug/incidents/{id}",
+                       handle_debug_incident_detail)
     app.router.add_post("/admin/rollout", handle_admin_rollout_post)
     app.router.add_get("/admin/rollout", handle_admin_rollout_get)
     app.router.add_post("/admin/rollout/abort", handle_admin_rollout_abort)
@@ -1385,6 +1589,38 @@ def create_app(cfg: ServiceConfig, engine: Engine,
         # stop for embedded/test usages that never send a signal.
         await app["service"].engine.stop()
 
+    async def _start_sentinel_watcher(app: web.Application) -> None:
+        # Incident watcher (ISSUE 15): a background evaluation loop, so
+        # triggers fire even when nothing scrapes /metrics. 0 disables
+        # it (scrape/read-driven evaluation only).
+        svc: Service = app["service"]
+        period = svc.cfg.sentinel_eval_secs
+        if period <= 0:
+            return
+
+        async def watch() -> None:
+            while True:
+                await asyncio.sleep(period)
+                try:
+                    new = svc.check_incidents()
+                    await _attach_incident_profiles(app, svc, new)
+                except asyncio.CancelledError:   # teardown
+                    raise
+                except Exception:   # pragma: no cover - defensive
+                    logger.exception("sentinel watcher failed")
+
+        app["_sentinel_task"] = asyncio.create_task(watch())
+
+    async def _stop_sentinel_watcher(app: web.Application) -> None:
+        task = app.get("_sentinel_task")
+        if task is not None:
+            task.cancel()
+            with suppress(asyncio.CancelledError):
+                await task
+            app["_sentinel_task"] = None
+
     app.on_startup.append(_start_engine)
+    app.on_startup.append(_start_sentinel_watcher)
+    app.on_cleanup.append(_stop_sentinel_watcher)
     app.on_cleanup.append(_stop_engine)
     return app

@@ -560,6 +560,52 @@ class Metrics:
         self._rollout_seen: dict = {}
         self._rollout_versions_seen: set = set()
 
+        # Perf-regression sentinel (ISSUE 15, obs/steptime.py): per-
+        # (phase, bucket) step-time quantiles set at scrape time from
+        # the engine's bounded digests. ``phase`` is the closed
+        # obs.STEP_PHASES set; ``bucket`` values come from the engine's
+        # KV/prefill bucket ladders — cardinality bounded by config,
+        # like the SLO windows. The breach-trip counter delta-mirrors
+        # the sentinel's edge-triggered total.
+        self.step_time = Gauge(
+            "step_time_seconds",
+            "Per-chunk device step time quantiles by phase and bucket "
+            "(p50 | p95 | p99 over the sentinel's trailing window)",
+            ["phase", "bucket", "quantile"],
+            registry=r,
+        )
+        self.step_tokens_per_sec = Gauge(
+            "step_tokens_per_sec",
+            "Trailing tokens/sec produced at this (phase, bucket) rung",
+            ["phase", "bucket"],
+            registry=r,
+        )
+        self.steptime_trips = Counter(
+            "steptime_breach_trips_total",
+            "Step-time sentinel breach transitions (p99 crossed the "
+            "baseline envelope; edge-triggered, not per scrape)",
+            registry=r,
+        )
+        self._steptime_seen = 0
+
+        # Incident capture (ISSUE 15, obs/incidents.py): bundles
+        # captured vs suppressed-by-cooldown, by trigger (the closed
+        # obs.incidents.TRIGGERS set).
+        self.incidents_captured = Counter(
+            "incidents_captured_total",
+            "Incident bundles assembled into the /debug/incidents ring",
+            ["trigger"],
+            registry=r,
+        )
+        self.incidents_suppressed = Counter(
+            "incidents_suppressed_total",
+            "Trigger firings swallowed by the per-trigger cooldown "
+            "(counted, never captured — bounds capture overhead)",
+            ["trigger"],
+            registry=r,
+        )
+        self._incidents_seen = {"captured": {}, "suppressed": {}}
+
         # Request-lifecycle phase attribution (obs/trace.py): where a
         # request's wall time went. The ``phase`` label is drawn from the
         # fixed obs.PHASES allowlist — cardinality is bounded by
@@ -791,6 +837,38 @@ class Metrics:
                 self.rollout_rollbacks.labels(cause=cause).inc(
                     total - prev)
                 self._rollout_seen[cause] = total
+
+    def observe_steptime(self, st: dict) -> None:
+        """Mirror the step-time sentinel snapshot (stats()["steptime"])
+        into Prometheus at scrape time — quantile/rate gauges set
+        directly, the edge-triggered trip total delta-inc'd."""
+        for d in (st.get("digests") or {}).values():
+            phase = str(d.get("phase", "?"))
+            bucket = str(d.get("bucket", "?"))
+            for q, key in (("p50", "p50_ms"), ("p95", "p95_ms"),
+                           ("p99", "p99_ms")):
+                self.step_time.labels(
+                    phase=phase, bucket=bucket, quantile=q).set(
+                    float(d.get(key, 0.0)) / 1000.0)
+            self.step_tokens_per_sec.labels(
+                phase=phase, bucket=bucket).set(d.get("tok_s", 0.0))
+        total = int(st.get("trips_total", 0))
+        if total > self._steptime_seen:
+            self.steptime_trips.inc(total - self._steptime_seen)
+            self._steptime_seen = total
+
+    def observe_incidents(self, snap: dict) -> None:
+        """Delta-mirror the incident manager's captured/suppressed
+        totals (by trigger) into Prometheus at scrape time."""
+        seen = self._incidents_seen
+        for key, counter in (("captured", self.incidents_captured),
+                             ("suppressed", self.incidents_suppressed)):
+            for trigger, total in (snap.get(f"{key}_total")
+                                   or {}).items():
+                prev = seen[key].get(trigger, 0)
+                if total > prev:
+                    counter.labels(trigger=trigger).inc(total - prev)
+                    seen[key][trigger] = total
 
     def observe_slo(self, slo: dict) -> None:
         """Mirror the SLO burn snapshot (stats()["slo"]) into

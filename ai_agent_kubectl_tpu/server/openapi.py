@@ -289,6 +289,48 @@ def build_openapi() -> Dict:
                 "404": _err("Engine exposes no goodput ledger"),
             },
         }},
+        "/debug/incidents": {"get": {
+            "summary": "Incident ring: anomaly-triggered evidence "
+                       "bundles, newest first",
+            "description": "Bundles the perf-regression sentinel filed "
+                           "automatically — a step-time p99 breach, an "
+                           "SLO fast-burn spike, a quarantine/grammar-"
+                           "dead-end spike, KV-pool exhaustion, or the "
+                           "breaker opening each assemble a bounded "
+                           "bundle (flight recorder, chunk rings, "
+                           "ledger/SLO/pool/spec health, config "
+                           "fingerprint, weights version) under a "
+                           "per-trigger cooldown. Reading runs one "
+                           "trigger evaluation first. Same auth/token "
+                           "gating as /debug/profile.",
+            "responses": {
+                "200": {"description": "{ring, captured_total, "
+                                       "suppressed_total, "
+                                       "last_incident_id, incidents: "
+                                       "[{id, trigger, at, detail}]}"},
+                "401": auth_err,
+                "403": _err("Invalid or missing X-Debug-Token"),
+            },
+        }},
+        "/debug/incidents/{id}": {"get": {
+            "summary": "One incident's full evidence bundle",
+            "parameters": [{
+                "name": "id", "in": "path", "required": True,
+                "schema": {"type": "string"},
+                "description": "Incident id from the index route",
+            }],
+            "responses": {
+                "200": {"description": "Full bundle: trigger, detail, "
+                                       "flight_recorder, chunks, "
+                                       "ledger, slo, qos, kv_pool, "
+                                       "spec, grammar, steptime, "
+                                       "config_fingerprint, "
+                                       "weights_version"},
+                "401": auth_err,
+                "403": _err("Invalid or missing X-Debug-Token"),
+                "404": _err("Incident not (or no longer) in the ring"),
+            },
+        }},
         "/admin/rollout": {
             "post": {
                 "summary": "Begin a zero-downtime weight rollout "

@@ -135,3 +135,13 @@ class HealthResponse(BaseModel):
     # cumulative rollbacks by cause. None = engine without swap support
     # (the per-replica versions also appear in the fleet section).
     rollout: Optional[Dict[str, Any]] = None
+    # Perf-regression sentinel (ISSUE 15, obs/steptime.py): per-(phase,
+    # bucket) step-time digests (p50/p95/p99, baseline, trailing
+    # tok/s), breach verdicts, and the edge-triggered trip total; the
+    # fleet rollup attributes breaches to replicas. None = engine
+    # without the chunked scheduler.
+    steptime: Optional[Dict[str, Any]] = None
+    # Incident capture (ISSUE 15, obs/incidents.py): ring occupancy,
+    # captured/suppressed totals by trigger, and the newest incident id
+    # (full bundles live behind token-gated /debug/incidents).
+    incidents: Optional[Dict[str, Any]] = None

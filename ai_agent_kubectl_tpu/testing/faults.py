@@ -581,6 +581,12 @@ class ChaosEngine:
         fn = getattr(self.inner, "spec_health", None)
         return fn() if callable(fn) else {}
 
+    def steptime_health(self) -> dict:
+        """Forward the step-time sentinel view (ISSUE 15) — the
+        incident watcher reads it through whatever wrapper serves."""
+        fn = getattr(self.inner, "steptime_health", None)
+        return fn() if callable(fn) else {}
+
     def ledger_snapshot(self) -> dict:
         """Forward the goodput ledger (/debug/ledger, ISSUE 8)."""
         fn = getattr(self.inner, "ledger_snapshot", None)

@@ -79,6 +79,24 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _steptime_summary(eng) -> Optional[dict]:
+    """The engine's step-time sentinel digests (obs/steptime.py) for the
+    artifact, plus a derived scalar the perf gate can band: the median
+    decode-phase p50 ms/step across rungs with a meaningful sample."""
+    fn = getattr(eng, "steptime_health", None)
+    snap = fn() if callable(fn) else None
+    if not snap or not snap.get("digests"):
+        return None
+    out: dict = {"digests": snap["digests"],
+                 "trips_total": snap.get("trips_total", 0)}
+    decode = [d["p50_ms"] for d in snap["digests"].values()
+              if d.get("phase") in ("decode", "spec_verify")
+              and d.get("count", 0) >= 8]
+    if decode:
+        out["decode_p50_ms"] = round(statistics.median(decode), 3)
+    return out
+
+
 def make_tokenizer(cfg):
     """Real BPE from the in-repo asset (or BENCH_TOKENIZER override);
     byte-level fallback only if the asset is missing."""
@@ -292,8 +310,10 @@ async def phase_7b(batch_size: int, max_seq: int, kv_quant: str,
         ttft7["ttft_device_profiled_ms"] = profiled
     s7 = await throughput_phase(
         eng7, conc=batch_size, max_tokens=64, rounds=3, tag="7b")
+    steptime = _steptime_summary(eng7)
     await eng7.stop()
     return {
+        "step_time": steptime,
         "model": "gemma-7b-it",
         "dtype": "bfloat16",
         "quant": "int8",
@@ -511,6 +531,7 @@ async def phase_pipe7b(batch_size: int, max_seq: int, kv_quant: str,
         eng, conc=batch_size, max_tokens=64, rounds=2,
         tag=f"pipe7b-d{pipe_depth}")
     stats = eng.stats()
+    steptime = _steptime_summary(eng)
     await eng.stop()
     return {
         "model": "gemma-7b-it",
@@ -518,6 +539,7 @@ async def phase_pipe7b(batch_size: int, max_seq: int, kv_quant: str,
         "max_seq_len": max_seq,
         "kv_quant": kv_quant,
         "pipe_depth": pipe_depth,
+        "step_time": steptime,
         "device_termination": stats.get("device_termination", True),
         "wasted_decode_steps": stats.get("wasted_decode_steps", 0),
         "chunks_dispatched": stats.get("chunks_dispatched", 0),
@@ -860,20 +882,29 @@ async def phase_2b() -> dict:
     log(f"bench: engine ready in {time.monotonic() - t0:.1f}s")
 
     # The round-2 bench disabled the prefix cache and skipped the system
-    # prompt entirely; this bench serves the true /kubectl-command path and
-    # refuses to report numbers if the cache silently no-ops.
-    assert engine._prefix is not None, \
-        "prefix-KV cache must be active for the real serving path"
-    prefix_tokens = engine._prefix.n
-    log(f"bench: prefix-KV cache ACTIVE ({prefix_tokens} tokens resident)")
+    # prompt entirely; this bench serves the true /kubectl-command path
+    # and refuses to report numbers if the cache silently no-ops. Prefix
+    # reuse is either the dense ladder's resident PrefixKV or the pool's
+    # radix-cached preload (same rule the 7B phase already applies — the
+    # pool is the default layout since PR 9, where _prefix stays None).
+    assert engine._prefix is not None or engine._use_pool, \
+        "prefix reuse must be active for the real serving path"
+    if engine._prefix is not None:
+        prefix_tokens = engine._prefix.n
+    else:
+        from ai_agent_kubectl_tpu.engine.prompts import SYSTEM_PROMPT
+        prefix_tokens = len(engine.tokenizer.encode(SYSTEM_PROMPT))
+    log(f"bench: prefix reuse ACTIVE ({prefix_tokens} tokens resident)")
 
     warm = await ttft_phase(engine, n=20, tag="2b-warm")
     samples = await throughput_phase(
         engine, conc=conc, max_tokens=max_tokens, rounds=rounds, tag="2b")
     tok_s_chip = statistics.median(samples) / n_chips
+    steptime = _steptime_summary(engine)
     await engine.stop()
 
     return {
+        "step_time": steptime,
         "platform": platform,
         "chips": n_chips,
         "model": model_name,
@@ -899,10 +930,14 @@ def _run_phase(args: list, timeout: float, script: str | None = None,
                env: dict | None = None) -> dict | None:
     """Run one phase subprocess; parse its final stdout line as JSON.
 
-    Also used by tools/bench_paged_gqa.py (pass ``script``) so there is one
-    hardened spawn-and-parse path: timeouts and non-JSON stdout are logged
-    failures (None), not tracebacks. ``env`` overrides the child
-    environment (the tp7b rungs force the 8-virtual-device CPU mesh)."""
+    Also used by tools/bench_paged_gqa.py (pass ``script``) so there is
+    one hardened spawn-and-parse path. Failures return an EXPLICIT
+    ``{"status": "timeout" | "error"}`` entry instead of None, and the
+    orchestrator records those entries into the artifact — the perf
+    gate (tools/perf_gate.py) must be able to tell "this phase got
+    slower" from "this phase silently vanished". ``env`` overrides the
+    child environment (the tp7b rungs force the 8-virtual-device CPU
+    mesh)."""
     cmd = [sys.executable, script or os.path.abspath(__file__)] + args
     log(f"bench: spawn {' '.join(args)}")
     try:
@@ -911,21 +946,37 @@ def _run_phase(args: list, timeout: float, script: str | None = None,
             env=env)
     except subprocess.TimeoutExpired:
         log(f"bench: phase {args} timed out after {timeout:.0f}s")
-        return None
+        return {"status": "timeout", "phase": list(args),
+                "timeout_secs": timeout}
     if proc.returncode != 0:
         log(f"bench: phase {args} exited {proc.returncode}")
-        return None
+        return {"status": "error", "phase": list(args),
+                "returncode": proc.returncode}
     lines = [ln for ln in proc.stdout.decode().splitlines() if ln.strip()]
     if not lines:
-        return None
+        return {"status": "error", "phase": list(args),
+                "detail": "no stdout"}
     try:
         return json.loads(lines[-1])
     except json.JSONDecodeError:
         log(f"bench: phase {args} emitted non-JSON: {lines[-1]!r}")
-        return None
+        return {"status": "error", "phase": list(args),
+                "detail": "non-JSON stdout"}
+
+
+def _ok(r: dict | None) -> bool:
+    """A phase result usable as data: present, not skipped-off-TPU, not
+    an explicit failure entry."""
+    return (isinstance(r, dict) and "skipped" not in r
+            and "status" not in r)
 
 
 def orchestrate() -> dict:
+    # Phase failures are RECORDED, not silently dropped: the perf gate
+    # must distinguish "this phase got slower" from "this phase timed
+    # out / crashed / vanished" (tools/perf_gate.py).
+    phase_failures: dict = {}
+
     # North-star model first (cleanest statement of the 7B numbers); each
     # rung is a fresh process so an OOM can't leak into later phases.
     extra7 = None
@@ -934,23 +985,25 @@ def orchestrate() -> dict:
             ["--phase", "7b", "--bs", str(bs), "--max-seq", str(max_seq),
              "--kv-quant", kvq],
             timeout=2400)
-        if r is not None and "skipped" in r:
+        if isinstance(r, dict) and "skipped" in r:
             log(f"bench: 7B phase skipped ({r['skipped']})")
             break
-        if r is not None:
+        if _ok(r):
             extra7 = r
             break
+        phase_failures[f"7b_bs{bs}"] = r
         log(f"bench: 7B rung bs={bs} failed; trying next")
 
     if extra7 is not None:
         # Attribute the step at the geometry that served (same bs/max_seq/
-        # kv_quant); a failed attribution must not cost the 7B numbers.
+        # kv_quant); a failed attribution must not cost the 7B numbers —
+        # but its explicit failure entry rides the artifact.
         rattr = _run_phase(
             ["--phase", "attr7b", "--bs", str(extra7["batch_size"]),
              "--max-seq", str(extra7["max_seq_len"]),
              "--kv-quant", extra7["kv_quant"]],
             timeout=1200)
-        if rattr is not None and "skipped" not in rattr:
+        if _ok(rattr) or (isinstance(rattr, dict) and "status" in rattr):
             extra7["step_attribution"] = rattr
 
         # CHUNK_PIPE_DEPTH sweep at the bs=64/48 rungs (ISSUE 4): one
@@ -972,14 +1025,20 @@ def orchestrate() -> dict:
                      "--kv-quant", extra7["kv_quant"],
                      "--pipe-depth", str(depth)],
                     timeout=1800)
-                if rp is None or "skipped" in rp:
+                if isinstance(rp, dict) and "skipped" in rp:
                     log(f"bench: pipe7b bs={bs} depth={depth} "
-                        f"unavailable; continuing sweep")
+                        f"skipped; continuing sweep")
+                    continue
+                if not _ok(rp):
+                    # Explicit failure entry — "this rung timed out"
+                    # must not read as "this rung was never tried".
+                    sweep[f"bs{bs}_depth{depth}"] = rp
                     continue
                 sweep[f"bs{bs}_depth{depth}"] = {
-                    k: rp[k] for k in ("tokens_per_sec_per_chip",
-                                       "wasted_decode_steps",
-                                       "chunks_pruned")
+                    k: rp.get(k) for k in ("tokens_per_sec_per_chip",
+                                           "wasted_decode_steps",
+                                           "chunks_pruned",
+                                           "step_time")
                 }
         if sweep:
             extra7["pipe_depth_sweep"] = sweep
@@ -998,11 +1057,13 @@ def orchestrate() -> dict:
                  "--kv-quant", extra7["kv_quant"],
                  "--kv-pool", "on", "--pool-envelope-bs", "64"],
                 timeout=1800)
-            if rp is not None and "skipped" not in rp:
+            if _ok(rp):
                 kv_sweep["pool"][f"bs{bs}"] = {
                     k: rp.get(k) for k in ("tokens_per_sec_per_chip",
                                            "kv_pool_blocks",
                                            "kv_pool_stats")}
+            elif isinstance(rp, dict) and "status" in rp:
+                kv_sweep["pool"][f"bs{bs}"] = rp
             if bs <= 96:
                 rd = _run_phase(
                     ["--phase", "paged7b", "--bs", str(bs),
@@ -1010,19 +1071,22 @@ def orchestrate() -> dict:
                      "--kv-quant", extra7["kv_quant"],
                      "--kv-pool", "off"],
                     timeout=1800)
-                kv_sweep["dense"][f"bs{bs}"] = (
-                    {"tokens_per_sec_per_chip":
-                     rd.get("tokens_per_sec_per_chip")}
-                    if rd is not None and "skipped" not in rd
-                    else {"failed": "allocation or start failed "
-                          "(dense ladder capacity ceiling)"})
+                if _ok(rd):
+                    kv_sweep["dense"][f"bs{bs}"] = {
+                        "tokens_per_sec_per_chip":
+                        rd.get("tokens_per_sec_per_chip")}
+                elif isinstance(rd, dict) and "status" in rd:
+                    # The datapoint, recorded explicitly: the dense
+                    # ladder stopped allocating/starting at this rung.
+                    kv_sweep["dense"][f"bs{bs}"] = rd
         ragent = _run_phase(
             ["--phase", "paged7b", "--bs", "8",
              "--max-seq", str(extra7["max_seq_len"]),
              "--kv-quant", extra7["kv_quant"],
              "--kv-pool", "on", "--agent-loop"],
             timeout=1800)
-        if ragent is not None and "skipped" not in ragent:
+        if _ok(ragent) or (isinstance(ragent, dict)
+                           and "status" in ragent):
             kv_sweep["agent_loop"] = ragent
         ragent_dense = _run_phase(
             ["--phase", "paged7b", "--bs", "8",
@@ -1030,7 +1094,8 @@ def orchestrate() -> dict:
              "--kv-quant", extra7["kv_quant"],
              "--kv-pool", "off", "--agent-loop"],
             timeout=1800)
-        if ragent_dense is not None and "skipped" not in ragent_dense:
+        if _ok(ragent_dense) or (isinstance(ragent_dense, dict)
+                                 and "status" in ragent_dense):
             kv_sweep["agent_loop_dense"] = ragent_dense
         if kv_sweep["pool"] or kv_sweep["dense"]:
             extra7["kv_pool_sweep"] = kv_sweep
@@ -1047,12 +1112,14 @@ def orchestrate() -> dict:
                  "--kv-quant", extra7["kv_quant"],
                  "--grammar", mode],
                 timeout=1800)
-            if rg is not None and "skipped" not in rg:
+            if _ok(rg):
                 gram_sweep[mode] = {
                     k: rg.get(k) for k in (
                         "decode_steps_per_command", "forced_token_ratio",
                         "fast_forward_splices", "tokens_per_sec_per_chip",
                         "completion_tokens")}
+            elif isinstance(rg, dict) and "status" in rg:
+                gram_sweep[mode] = rg
         if gram_sweep:
             extra7["grammar_sweep"] = gram_sweep
 
@@ -1069,8 +1136,10 @@ def orchestrate() -> dict:
              "--max-seq", str(extra7["max_seq_len"]),
              "--kv-quant", extra7["kv_quant"], "--spec", "off"],
             timeout=1800)
-        if rs is not None and "skipped" not in rs:
+        if _ok(rs):
             spec_sweep["off"] = {k: rs.get(k) for k in spec_keys}
+        elif isinstance(rs, dict) and "status" in rs:
+            spec_sweep["off"] = rs
         for k in (2, 4, 8):
             rs = _run_phase(
                 ["--phase", "spec7b", "--bs", "48",
@@ -1078,17 +1147,21 @@ def orchestrate() -> dict:
                  "--kv-quant", extra7["kv_quant"],
                  "--spec", "on", "--spec-k", str(k)],
                 timeout=1800)
-            if rs is not None and "skipped" not in rs:
+            if _ok(rs):
                 spec_sweep[f"k{k}"] = {kk: rs.get(kk)
                                        for kk in spec_keys}
+            elif isinstance(rs, dict) and "status" in rs:
+                spec_sweep[f"k{k}"] = rs
         rs = _run_phase(
             ["--phase", "spec7b", "--bs", "48",
              "--max-seq", str(extra7["max_seq_len"]),
              "--kv-quant", extra7["kv_quant"],
              "--spec", "on", "--spec-k", "4", "--grammar", "on"],
             timeout=1800)
-        if rs is not None and "skipped" not in rs:
+        if _ok(rs):
             spec_sweep["k4_grammar"] = {k: rs.get(k) for k in spec_keys}
+        elif isinstance(rs, dict) and "status" in rs:
+            spec_sweep["k4_grammar"] = rs
         if spec_sweep:
             extra7["spec_sweep"] = spec_sweep
 
@@ -1115,12 +1188,12 @@ def orchestrate() -> dict:
                 ["--phase", "tp7b", "--bs", str(bs), "--mesh", "tp=8",
                  "--max-seq", "256", "--model", tp_model],
                 timeout=3600, env=tp_env)
-            if rt is not None and "skipped" in rt:
+            if isinstance(rt, dict) and "skipped" in rt:
                 log(f"bench: tp7b rung bs={bs} skipped ({rt['skipped']})")
                 continue
-            if rt is not None:
-                tp_rungs.append(rt)
-            else:
+            # Failure entries ride the rung list explicitly.
+            tp_rungs.append(rt)
+            if not _ok(rt):
                 log(f"bench: tp7b rung bs={bs} failed; continuing")
         if tp_rungs:
             extra7["tp_sweep"] = {"mesh": "tp=8", "model": tp_model,
@@ -1129,13 +1202,17 @@ def orchestrate() -> dict:
     rmoe = _run_phase(["--phase", "moe"], timeout=2400)
 
     r2 = _run_phase(["--phase", "2b"], timeout=2400)
-    if r2 is None:
-        raise RuntimeError("headline (2B/toy) bench phase failed")
+    if not _ok(r2):
+        raise RuntimeError(f"headline (2B/toy) bench phase failed: {r2}")
 
     tok_s_chip = r2.pop("tokens_per_sec_per_chip")
     extra = dict(r2)
-    if rmoe is not None and "skipped" not in rmoe:
+    if _ok(rmoe):
         extra["mixtral_scaled_moe"] = rmoe
+    elif isinstance(rmoe, dict) and "status" in rmoe:
+        phase_failures["moe"] = rmoe
+    if phase_failures:
+        extra["phase_failures"] = phase_failures
     if extra7 is not None:
         extra["gemma_7b"] = extra7
         # Mirror the north-star latency clause at the top level, explicitly

@@ -120,8 +120,11 @@ def run_child(decode_attn: str) -> dict:
 
     r = _run_phase(["--impl", decode_attn], timeout=2400,
                    script=os.path.abspath(__file__))
-    if r is None:
-        raise RuntimeError(f"{decode_attn} child failed (see stderr above)")
+    # _run_phase reports failures as explicit {"status": "timeout" |
+    # "error"} entries (bench.py) — either shape is a failed child here.
+    if r is None or "status" in r:
+        raise RuntimeError(
+            f"{decode_attn} child failed ({r}; see stderr above)")
     return r
 
 

@@ -140,6 +140,7 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
     print_goodput_summary(gauges)
     print_spec_summary(gauges)
     print_slo_summary(gauges)
+    print_steptime_summary(gauges)
 
 
 def _sum_labelled(gauges: Dict[str, float], name: str) -> Dict[str, float]:
@@ -421,6 +422,118 @@ def print_slo_summary(gauges: Dict[str, float]) -> None:
             f"{breaches[labels]:.0f}")
 
 
+def print_steptime_summary(gauges: Dict[str, float]) -> None:
+    """Step-time sentinel (ISSUE 15) from the same /metrics scrape:
+    per-(phase, bucket) p50/p95/p99 and the per-rung trailing tok/s —
+    the regression view next to the throughput view."""
+    times = _sum_labelled(gauges, "step_time_seconds")
+    if not times:
+        return      # engine without the sentinel
+    rates = _sum_labelled(gauges, "step_tokens_per_sec")
+    rows: Dict[tuple, Dict[str, float]] = {}
+    for labels, v in times.items():
+        d = _parse_labels(labels)
+        key = (d.get("phase", "?"), d.get("bucket", "?"))
+        rows.setdefault(key, {})[d.get("quantile", "?")] = v * 1000.0
+    log("probe[steptime]: step-time sentinel (ms)")
+    log(f"  {'phase':<12} {'bucket':>7} {'p50':>9} {'p95':>9} "
+        f"{'p99':>9} {'tok/s':>9}")
+    for (phase, bucket) in sorted(rows):
+        row = rows[(phase, bucket)]
+        rate = rates.get(f'bucket="{bucket}",phase="{phase}"',
+                         rates.get(f'phase="{phase}",bucket="{bucket}"',
+                                   0.0))
+        log(f"  {phase:<12} {bucket:>7} {row.get('p50', 0.0):>9.2f} "
+            f"{row.get('p95', 0.0):>9.2f} {row.get('p99', 0.0):>9.2f} "
+            f"{rate:>9.0f}")
+    trips = gauges.get("steptime_breach_trips_total", 0.0)
+    log(f"  breach trips total          {trips:>8.0f}")
+    captured = _sum_labelled(gauges, "incidents_captured_total")
+    if captured:
+        log("  incidents captured          "
+            + ", ".join(f"{k.split('=')[-1].strip(chr(34))}={v:.0f}"
+                        for k, v in sorted(captured.items())))
+
+
+def watch_deltas(prev: Dict[str, float], cur: Dict[str, float],
+                 dt: float) -> Dict[str, object]:
+    """One --watch interval's delta rates from two /metrics scrapes:
+    tok/s (token-counter delta), goodput%% (delivered vs total ledger
+    steps this interval), spec acceptance (accepted vs drafted this
+    interval), and the current decode step-time p95 (a gauge — no
+    delta). Pure function so the triage math is unit-testable."""
+    def delta(name: str) -> float:
+        return max(0.0, cur.get(name, 0.0) - prev.get(name, 0.0))
+
+    tok_s = delta("engine_tokens_generated_total") / dt if dt > 0 else 0.0
+    d_total = d_delivered = 0.0
+    for labels, v in _sum_labelled(cur, "goodput_steps_total").items():
+        dv = max(0.0, v - _sum_labelled(prev, "goodput_steps_total")
+                 .get(labels, 0.0))
+        d_total += dv
+        if _parse_labels(labels).get("class") == "delivered":
+            d_delivered += dv
+    goodput = (100.0 * d_delivered / d_total) if d_total else None
+    d_drafted = delta("spec_drafted_tokens_total")
+    d_accepted = delta("spec_accepted_tokens_total")
+    acceptance = (d_accepted / d_drafted) if d_drafted else None
+    p95 = None
+    for labels, v in _sum_labelled(cur, "step_time_seconds").items():
+        d = _parse_labels(labels)
+        if d.get("phase") in ("decode", "spec_verify") \
+                and d.get("quantile") == "p95":
+            p95 = max(p95 or 0.0, v * 1000.0)
+    return {"tok_s": tok_s, "goodput_pct": goodput,
+            "acceptance": acceptance, "step_p95_ms": p95,
+            "trips": delta("steptime_breach_trips_total"),
+            "incidents": sum(
+                max(0.0, v - _sum_labelled(prev,
+                                           "incidents_captured_total")
+                    .get(k, 0.0))
+                for k, v in _sum_labelled(
+                    cur, "incidents_captured_total").items())}
+
+
+async def watch_loop(session, base_url: str, headers, interval: float,
+                     rounds: int) -> None:
+    """--watch N: re-scrape /metrics every N seconds and print one
+    delta-rate line per interval — live incident triage without a
+    Prometheus server in the loop. rounds=0 runs until interrupted."""
+    log(f"probe[watch]: scraping {base_url}/metrics every "
+        f"{interval:.1f}s (Ctrl-C to stop)")
+    log(f"  {'t':>6} {'tok/s':>9} {'goodput':>9} {'accept':>8} "
+        f"{'step p95':>10} {'trips':>6} {'incid':>6}")
+    prev = None
+    t_prev = t0 = time.monotonic()
+    n = 0
+    while rounds <= 0 or n < rounds:
+        await asyncio.sleep(interval)
+        # Count every ATTEMPT: an unreachable server must not turn a
+        # bounded --watch-rounds run into an infinite loop. The first
+        # successful scrape only establishes the baseline (rounds=N
+        # means N scrapes, N-1 delta lines).
+        n += 1
+        try:
+            async with session.get(base_url + "/metrics",
+                                   headers=headers) as resp:
+                cur = parse_prom_gauges(await resp.text())
+        except Exception as e:  # pragma: no cover - network-dependent
+            log(f"probe[watch]: /metrics unreachable ({e})")
+            continue
+        now = time.monotonic()
+        if prev is not None:
+            row = watch_deltas(prev, cur, now - t_prev)
+            acc = row["acceptance"]
+            gp = row["goodput_pct"]
+            p95 = row["step_p95_ms"]
+            log(f"  {now - t0:>5.0f}s {row['tok_s']:>9.1f} "
+                f"{(f'{gp:.1f}%' if gp is not None else '-'):>9} "
+                f"{(f'{acc:.0%}' if acc is not None else '-'):>8} "
+                f"{(f'{p95:.2f}ms' if p95 is not None else '-'):>10} "
+                f"{row['trips']:>6.0f} {row['incidents']:>6.0f}")
+        prev, t_prev = cur, now
+
+
 async def http_probe(args) -> None:
     """Drive a live server: per-request Server-Timing phases + summary."""
     import aiohttp
@@ -430,6 +543,13 @@ async def http_probe(args) -> None:
     headers = {}
     if args.api_key:
         headers["X-API-Key"] = args.api_key
+    if args.watch:
+        import aiohttp as _aiohttp
+
+        async with _aiohttp.ClientSession() as session:
+            await watch_loop(session, base, headers, args.watch,
+                             args.watch_rounds)
+        return
     samples: Dict[str, List[float]] = defaultdict(list)
     sem = asyncio.Semaphore(args.concurrency)
 
@@ -483,6 +603,15 @@ async def main() -> None:
                     help="HTTP mode: concurrent requests in flight")
     ap.add_argument("--api-key", default=None,
                     help="HTTP mode: X-API-Key value")
+    ap.add_argument("--watch", type=float, default=None,
+                    help="HTTP mode: instead of firing requests, "
+                         "re-scrape /metrics every N seconds and print "
+                         "delta rates (tok/s, goodput, acceptance, "
+                         "step-time p95) for live incident triage")
+    ap.add_argument("--watch-rounds", type=int, default=0,
+                    help="stop --watch after this many scrapes (the "
+                         "first establishes the baseline, so N scrapes "
+                         "print N-1 delta lines; 0 = until interrupted)")
     args = ap.parse_args()
 
     if args.url:
