@@ -95,6 +95,43 @@ def _mesh_device_count(spec: str) -> int:
     return total
 
 
+#: jax-free mirror of parallel/mesh.py::MeshConfig.parse's alias map —
+#: config validation must not import jax (the fake/openai deployments
+#: stay jax-free), but the spec-decode capability check (ISSUE 18)
+#: needs to know WHICH axes a mesh spec scales, not just how many
+#: devices it asks for.
+_MESH_AXIS_ALIASES = {
+    "dp": "data", "data": "data",
+    "ep": "expert", "expert": "expert",
+    "pp": "pipe", "pipe": "pipe",
+    "sp": "seq", "seq": "seq",
+    "tp": "model", "model": "model",
+}
+
+#: axes speculative decoding cannot serve under: the spec pool's blocks
+#: are a shared cross-slot structure (never shard over data/pipe/seq)
+#: and the draft stack rides the mesh whole (no pipeline split).
+_SPEC_UNSHARDABLE_AXES = frozenset({"data", "pipe", "seq"})
+
+
+def _mesh_unshardable_axes(spec: str) -> set:
+    """Canonical names of >1 data/pipe/seq axes a MESH_SHAPE /
+    DCN_MESH_SHAPE spec asks for — the combinations SPEC_DECODE refuses
+    (ISSUE 18). Unknown axis names are the engine's error to raise and
+    are ignored here, mirroring ``_mesh_device_count``."""
+    out = set()
+    for part in filter(None, (p.strip() for p in (spec or "").split(","))):
+        name, _, val = part.replace(":", "=").partition("=")
+        canon = _MESH_AXIS_ALIASES.get(name.strip().lower())
+        try:
+            size = int(val)
+        except ValueError:
+            continue
+        if canon in _SPEC_UNSHARDABLE_AXES and size > 1:
+            out.add(canon)
+    return out
+
+
 def _env_str(name: str, default: Optional[str]) -> Optional[str]:
     v = os.getenv(name)
     return v if v not in (None, "") else default
@@ -662,20 +699,27 @@ class ServiceConfig:
                     f"(vocab {draft.vocab_size}) does not share "
                     f"{self.model_name!r}'s vocab ({target.vocab_size}) "
                     f"— draft and verifier must use one tokenizer")
-            # ISSUE 14: the KV pool now serves under TP/EP meshes, which
-            # makes SPEC_DECODE + MESH_SHAPE *reachable* — but the draft
-            # engine's dense per-slot cache and the multi-token verify
-            # window have no sharded variants. Refuse loudly at boot
-            # rather than silently mis-compose (the engine re-checks at
-            # start for direct construction).
-            mesh_devs = (_mesh_device_count(self.mesh_shape)
-                         * _mesh_device_count(self.dcn_mesh_shape))
-            if mesh_devs > 1:
+            # ISSUE 18: the draft world is mesh-native under tp/ep —
+            # draft cache/params shard per parallel/sharding.py's
+            # draft_cache_specs and the spec chunk compiles against the
+            # mesh — so SPEC_DECODE + MESH_SHAPE now composes. What
+            # remains genuinely unshardable is the spec pool's
+            # requirement (blocks never shard over data/pipe/seq) plus
+            # the draft's whole-stack ride of the mesh: refuse only a
+            # >1 data/pipe/seq axis (the engine re-checks at start for
+            # direct construction; the capability check stays jax-free).
+            bad = sorted(
+                _mesh_unshardable_axes(self.mesh_shape)
+                | _mesh_unshardable_axes(self.dcn_mesh_shape))
+            if bad:
                 raise ValueError(
-                    f"SPEC_DECODE does not compose with a multi-device "
-                    f"serving mesh (MESH_SHAPE={self.mesh_shape!r} "
-                    f"DCN_MESH_SHAPE={self.dcn_mesh_shape!r} = "
-                    f"{mesh_devs} devices); disable one of them")
+                    f"SPEC_DECODE does not compose with a mesh that has "
+                    f"a >1 {'/'.join(bad)} axis (MESH_SHAPE="
+                    f"{self.mesh_shape!r} DCN_MESH_SHAPE="
+                    f"{self.dcn_mesh_shape!r}): the spec KV pool's "
+                    f"blocks and the draft verify window shard over "
+                    f"tp/ep only — use a tensor/expert-parallel mesh or "
+                    f"disable one of them")
 
     @property
     def tenant_tier_map(self) -> dict:

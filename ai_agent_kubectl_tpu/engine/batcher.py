@@ -852,6 +852,8 @@ class BatchedJaxEngine(JaxEngine):
         self._spec_drafted = 0        # cumulative draft proposals
         self._spec_accepted = 0       # cumulative accepted drafts
         self._spec_degraded = 0       # draft-engine-death degradations
+        self._draft_sharded = False   # draft world rides the mesh
+        self._draft_kv_fallback = False  # draft KV replicated (gather)
         self._draft_cfg = None
         self._draft_params = None
         self._draft_cache = None
@@ -1091,17 +1093,21 @@ class BatchedJaxEngine(JaxEngine):
         self._first_consumed = False  # re-arm the cold-start watchdog grace
         self._setup_compile_cache()
         self._setup_mesh()
-        # Speculative decoding never composes with a multi-device mesh:
-        # the draft's dense per-slot cache and the verify window's
-        # multi-token forward have no sharded variants, and a silently
-        # mis-composed draft would burn chips without the parity
-        # guarantee. Config validation rejects the combination at boot;
-        # this is the belt-and-braces check for direct construction.
-        if (self.spec_decode and self.mesh is not None
-                and self.mesh.size > 1):
+        # Speculative decoding under the mesh (ISSUE 18): the draft
+        # world is mesh-native — draft params/cache shard per
+        # parallel/sharding.py::draft_cache_specs and the spec chunk
+        # compiles against the mesh — so spec now composes with tp/ep.
+        # What stays refused is a >1 data/pipe/seq axis: the spec pool's
+        # blocks are a shared cross-slot structure and the draft stack
+        # rides the mesh whole (no pipeline split). Config validation
+        # mirrors this jax-free; this is the belt-and-braces check for
+        # direct construction.
+        if (self.spec_decode and self.mesh is not None and any(
+                self.mesh.shape[a] > 1 for a in ("data", "pipe", "seq"))):
             raise ValueError(
-                "SPEC_DECODE does not compose with a multi-device "
-                "serving mesh (MESH_SHAPE); disable one of them")
+                "SPEC_DECODE does not compose with a mesh that has a "
+                ">1 data/pipe/seq axis (MESH_SHAPE); use a tensor/"
+                "expert-parallel mesh or disable one of them")
         self._load()
         # Block-paged KV pool (ISSUE 10 → ISSUE 14): the default
         # serving layout, now composing with TP/EP serving meshes — the
@@ -1144,13 +1150,13 @@ class BatchedJaxEngine(JaxEngine):
                 self._grammar.health()["grammar_hash"],
                 self._grammar.health()["states"],
                 self._grammar.health()["classes"])
-        # Speculative decoding (ISSUE 12): resolve + load the draft
-        # model. Pool-only — the rejected-row discipline ("last
-        # generated row unwritten", replay chains stop at emitted[:-1])
-        # is the pool contract, and the pool is the default layout; the
-        # dense ladder falls back to plain decode. (Multi-device meshes
-        # were already refused above — ISSUE 14 made pool+mesh serve,
-        # so the pool gate alone no longer keeps spec+mesh unreachable.)
+        # Speculative decoding (ISSUE 12 → ISSUE 18): resolve + load
+        # the draft model. Pool-only — the rejected-row discipline
+        # ("last generated row unwritten", replay chains stop at
+        # emitted[:-1]) is the pool contract, and the pool is the
+        # default layout; the dense ladder falls back to plain decode.
+        # tp/ep meshes serve sharded (data/pipe/seq were refused above,
+        # which keeps _use_spec implying mesh_pool_ok).
         self._use_spec = self.spec_decode and self._use_pool
         if self.spec_decode and not self._use_pool:
             logger.warning(
@@ -1190,6 +1196,34 @@ class BatchedJaxEngine(JaxEngine):
                 self._draft_params = init_params(
                     jax.random.PRNGKey(dseed), draft_cfg,
                     dtype=self.dtype)
+            # Draft world on the mesh (ISSUE 18): the draft's params
+            # shard through the SAME policy as the target's (Megatron
+            # column/row splits, vocab-sharded embed/head) so the 2B's
+            # forwards and its residual path ride the f≈1 layout PR 14
+            # gave the 7B. Its KV cache shards on the KV-head axis
+            # (draft_cache_specs) — when the draft's KV heads don't
+            # divide tp (gemma-2b-it's single head under tp=8) the
+            # cache replicates and draft attention runs gathered:
+            # correct, slower, and LOUD (_draft_kv_fallback rides
+            # /health + /metrics).
+            if self.mesh is not None and self.mesh.size > 1:
+                from ..parallel.sharding import (draft_kv_fallback,
+                                                 shard_params)
+                self._draft_params = shard_params(
+                    self._draft_params, self.mesh, draft_cfg)
+                self._draft_sharded = True
+                self._draft_kv_fallback = draft_kv_fallback(
+                    self.mesh, draft_cfg)
+                if self._draft_kv_fallback:
+                    logger.warning(
+                        "draft %s KV heads (%d) do not divide the "
+                        "mesh's model axis (%d); draft KV serves "
+                        "replicated (gather fallback)",
+                        draft_cfg.name, draft_cfg.n_kv_heads,
+                        self.mesh.shape["model"])
+            else:
+                self._draft_sharded = False
+                self._draft_kv_fallback = False
             self._spec_steps = max(
                 1, self.chunk_len // (self.spec_draft_k + 1))
             self._chunk_tokens = self._spec_steps * (self.spec_draft_k
@@ -1204,6 +1238,8 @@ class BatchedJaxEngine(JaxEngine):
             self._spec_steps = 0
             self._chunk_tokens = self.chunk_len
             self._spec_live = False
+            self._draft_sharded = False
+            self._draft_kv_fallback = False
         if not self._use_pool:
             self._build_prefill_fns()
             self._init_prefix_cache()
@@ -1512,19 +1548,25 @@ class BatchedJaxEngine(JaxEngine):
             }
 
         if self._use_spec:
-            # Speculative draft/verify chunk programs (ISSUE 12), one
-            # per KV bucket beside the plain set — both stay compiled so
-            # a draft:die drill flips to plain decode mid-stream with
-            # zero recompiles. The draft runs a dense per-slot cache at
-            # the SAME kv_limit (positions are shared) and never the
-            # paged kernel or a mesh.
+            # Speculative draft/verify chunk programs (ISSUE 12 →
+            # ISSUE 18), one per KV bucket beside the plain set — both
+            # stay compiled so a draft:die drill flips to plain decode
+            # mid-stream with zero recompiles (on a mesh: both PROGRAM
+            # SETS compile against the mesh at warmup, so the flip is
+            # recompile-free there too). The draft runs a dense
+            # per-slot cache at the SAME kv_limit (positions are
+            # shared) and never the paged kernel; it DOES ride the
+            # serving mesh — its forwards and residual path shard
+            # through the same f≈1 policy as the target's
+            # (parallel/sharding.py), with the KV-head axis replicating
+            # when it doesn't divide tp (draft_kv_fallback).
             dcfg = self._draft_cfg
 
             def draft_forward_step(kv_limit):
                 def dstep(dparams, tok, pos, dcache, live):
                     return forward(dparams, dcfg, tok, pos, dcache,
                                    kv_limit=kv_limit, attn_impl="dense",
-                                   mesh=None, moe_impl="dense",
+                                   mesh=self.mesh, moe_impl="dense",
                                    token_mask=live[:, None],
                                    write_mask=live)
 
@@ -1803,6 +1845,14 @@ class BatchedJaxEngine(JaxEngine):
         if self._use_spec:
             self._draft_cache = KVCache.zeros(
                 self._draft_cfg, N, self._S_alloc, dtype=self.dtype)
+            if self.mesh is not None:
+                # Mesh-native draft world (ISSUE 18): KV heads over
+                # ``model`` like the target's cache, slots over ``data``
+                # (a no-op on pure-tp meshes); a non-dividing KV-head
+                # axis sanitizes to replicated — the gather fallback.
+                from ..parallel.sharding import shard_draft_cache
+                self._draft_cache = shard_draft_cache(
+                    self._draft_cache, self.mesh, self._draft_cfg)
         if self.mesh is not None:
             from ..parallel.sharding import shard_tokens
 
@@ -2316,6 +2366,12 @@ class BatchedJaxEngine(JaxEngine):
                 self.mesh, self.batch_size, self.model_cfg.dim),
             "pool_sharded": bool(self._use_pool),
             "kv_pool_mesh_fallback": bool(self._kv_pool_mesh_fallback),
+            # ISSUE 18: whether the draft world rides the mesh, and
+            # whether its KV serves replicated because the draft's KV
+            # heads don't divide tp (the gather fallback — correct but
+            # off the shard-local fast path; fleets OR this flag).
+            "draft_sharded": bool(self._draft_sharded),
+            "draft_kv_fallback": bool(self._draft_kv_fallback),
         }
 
     def kv_pool_health(self) -> Optional[dict]:
@@ -2352,7 +2408,9 @@ class BatchedJaxEngine(JaxEngine):
         ([1, bucket] tokens at absolute offsets) — the 2B twin of the
         pool prefill path, feeding ``_draft_prefill_slot``'s bucket
         loop. Dense attention: the draft is small and this is the
-        admission path, not the decode hot loop."""
+        admission path, not the decode hot loop. Rides the serving
+        mesh (ISSUE 18) like the target's pool prefill so the sharded
+        draft params never gather for an admission."""
         key = (bucket, kv_limit)
         fn = self._draft_prefill_fns.get(key)
         if fn is None:
@@ -2364,7 +2422,7 @@ class BatchedJaxEngine(JaxEngine):
                     mask.sum(axis=1).astype(jnp.int32) - 1, 0)
                 return forward(dparams, dcfg, tokens, positions,
                                scratch, kv_limit=kv_limit,
-                               attn_impl="dense", mesh=None,
+                               attn_impl="dense", mesh=self.mesh,
                                moe_impl="dense", token_mask=mask,
                                logits_at=last)
 
@@ -2428,6 +2486,15 @@ class BatchedJaxEngine(JaxEngine):
         if start == 0:
             scratch = KVCache.zeros(self._draft_cfg, 1, self._S_alloc,
                                     dtype=self.dtype)
+            if self.mesh is not None:
+                # Sharded at every arm site (ISSUE 18): the scratch
+                # carries the same KV-head sharding as the slot cache
+                # (batch 1 sanitizes the data axis away), so the
+                # bucketed prefill loop and the splice-back never
+                # reshard mid-admission/replay/fast-forward.
+                from ..parallel.sharding import shard_draft_cache
+                scratch = shard_draft_cache(scratch, self.mesh,
+                                            self._draft_cfg)
         else:
             scratch = self._draft_extract_fn(
                 self._draft_cache, jnp.asarray(slot_idx, jnp.int32))
@@ -2480,6 +2547,10 @@ class BatchedJaxEngine(JaxEngine):
             "acceptance_ratio": (round(self._spec_accepted / drafted, 4)
                                  if drafted else None),
             "degraded_total": self._spec_degraded,
+            # ISSUE 18: spec under the mesh — mirrors sharding_health
+            # so the acceptance table and the mesh view tell one story.
+            "draft_sharded": bool(self._draft_sharded),
+            "draft_kv_fallback": bool(self._draft_kv_fallback),
         }
 
     # ------------------------------- grammar-constrained decode (ISSUE 11)

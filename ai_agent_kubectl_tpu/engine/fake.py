@@ -425,6 +425,10 @@ class FakeChunkedEngine:
         self._spec_drafted = 0
         self._spec_accepted = 0
         self._spec_degraded = 0
+        # ISSUE 18 surface parity: the fake has no mesh, so its draft
+        # world is never sharded and never in the gather fallback.
+        self._draft_sharded = False
+        self._draft_kv_fallback = False
 
     # ----------------------------------- speculative decoding (mirror)
 
@@ -465,6 +469,8 @@ class FakeChunkedEngine:
             "acceptance_ratio": (round(self._spec_accepted / drafted, 4)
                                  if drafted else None),
             "degraded_total": self._spec_degraded,
+            "draft_sharded": self._draft_sharded,
+            "draft_kv_fallback": self._draft_kv_fallback,
         }
 
     # ------------------------------------- block-paged KV pool (mirror)

@@ -1213,6 +1213,7 @@ class EngineFleet:
         under a requested pool must surface at the fleet level."""
         agg: dict = {}
         fallback = False
+        draft_fallback = False
         for rep in self.replicas:
             fn = getattr(rep.engine, "sharding_health", None)
             if not callable(fn):
@@ -1224,11 +1225,17 @@ class EngineFleet:
             if not s:
                 continue
             fallback = fallback or bool(s.get("kv_pool_mesh_fallback"))
+            draft_fallback = (draft_fallback
+                              or bool(s.get("draft_kv_fallback")))
             if not agg:
                 agg = dict(s)
         if not agg:
             return {}
         agg["kv_pool_mesh_fallback"] = fallback
+        # ISSUE 18: ANY replica serving the draft KV replicated (the
+        # gather fallback) must surface at the fleet level, same rule
+        # as the pool's loud fallback.
+        agg["draft_kv_fallback"] = draft_fallback
         return agg
 
     def grammar_health(self) -> dict:
@@ -1274,6 +1281,7 @@ class EngineFleet:
         agg: dict = {}
         seen = False
         active = True
+        draft_fallback = False
         for rep in self.replicas:
             fn = getattr(rep.engine, "spec_health", None)
             if not callable(fn):
@@ -1286,14 +1294,18 @@ class EngineFleet:
                 continue
             seen = True
             active = active and bool(s.get("active"))
+            draft_fallback = (draft_fallback
+                              or bool(s.get("draft_kv_fallback")))
             for k, v in s.items():
                 if k.endswith("_total") and isinstance(v, (int, float)):
                     agg[k] = agg.get(k, 0) + v
-                elif k not in ("active", "acceptance_ratio"):
+                elif k not in ("active", "acceptance_ratio",
+                               "draft_kv_fallback"):
                     agg[k] = v
         if not seen:
             return {}
         agg["active"] = active
+        agg["draft_kv_fallback"] = draft_fallback
         drafted = agg.get("drafted_tokens_total", 0)
         agg["acceptance_ratio"] = (
             round(agg.get("accepted_tokens_total", 0) / drafted, 4)

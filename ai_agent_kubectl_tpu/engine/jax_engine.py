@@ -351,6 +351,8 @@ class JaxEngine:
                 self.mesh, 1, self.model_cfg.dim),
             "pool_sharded": False,
             "kv_pool_mesh_fallback": False,
+            "draft_sharded": False,
+            "draft_kv_fallback": False,
         }
 
     @staticmethod

@@ -125,6 +125,53 @@ def pool_cache_specs(cfg: ModelConfig) -> Dict[str, P]:
     return {"k": kv, "v": kv, "lengths": P()}
 
 
+def draft_cache_specs(cfg: ModelConfig) -> Dict[str, P]:
+    """Draft-world KVCache sharding (ISSUE 18): the 2B's dense per-slot
+    [L2, N, S_alloc, KV2, hd] cache shards on the KV-head axis over
+    ``model`` exactly like the target's ``cache_specs``, batch (slots)
+    over ``data``. No pipe factor — the draft stack is never pipelined
+    (it rides the tp/ep mesh whole). When the draft's KV heads don't
+    divide the model axis (gemma-2b-it's single KV head under tp=8),
+    ``sanitize_spec`` drops the axis and the cache replicates — the
+    gather fallback ``draft_kv_fallback`` reports."""
+    kv = P(None, "data", None, "model", None)
+    return {"k": kv, "v": kv, "lengths": P("data")}
+
+
+def draft_kv_fallback(mesh: Optional[Mesh], cfg: ModelConfig) -> bool:
+    """True when the draft's KV-head axis does NOT divide the mesh's
+    ``model`` axis, i.e. the draft KV cache serves replicated (each TP
+    shard holds the full draft KV and the draft attention runs
+    gathered). Correct but off the shard-local fast path — surfaced in
+    /health's spec/sharding sections so a fleet can see which replicas
+    pay the gather."""
+    if (mesh is None or "model" not in mesh.axis_names
+            or mesh.shape["model"] <= 1):
+        return False
+    return cfg.n_kv_heads % mesh.shape["model"] != 0
+
+
+def shard_draft_cache(cache, mesh: Mesh, cfg: ModelConfig):
+    """device_put the draft's dense KVCache onto the mesh per
+    ``draft_cache_specs`` (divisibility-sanitized per leaf, so the
+    single-KV-head 2B under tp=8 lands replicated rather than erroring).
+    QuantKV is deliberately not special-cased: the draft cache is kept
+    in the serving dtype (KV_QUANT applies to the target pool only)."""
+    from ..models.transformer import KVCache
+
+    specs = draft_cache_specs(cfg)
+
+    def _put(a, spec):
+        return jax.device_put(
+            a, NamedSharding(mesh, sanitize_spec(mesh, spec, a.shape)))
+
+    return KVCache(
+        k=_put(cache.k, specs["k"]),
+        v=_put(cache.v, specs["v"]),
+        lengths=_put(cache.lengths, specs["lengths"]),
+    )
+
+
 def residual_spec(mesh: Mesh, shape: tuple) -> Optional[P]:
     """Where the [B, S, d] residual's TP factor lands under f≈1
     residual-path sharding (ISSUE 14): the batch axis when data×model

@@ -220,6 +220,23 @@ class Metrics:
             "dense fallback must be visible",
             registry=r,
         )
+        # Spec decode under the mesh (ISSUE 18): whether the draft
+        # world rides the mesh sharded, and whether its KV serves
+        # replicated because the draft's KV heads don't divide tp (the
+        # gather fallback — correct but off the shard-local fast path).
+        self.spec_draft_sharded = Gauge(
+            "spec_draft_sharded",
+            "1 when the speculative draft model's params/KV are "
+            "sharded over the serving mesh",
+            registry=r,
+        )
+        self.spec_draft_kv_fallback = Gauge(
+            "spec_draft_kv_fallback",
+            "1 when the draft's KV heads do not divide the mesh's "
+            "model axis and its KV cache serves replicated (gather "
+            "fallback) — a silent gather must be visible",
+            registry=r,
+        )
 
         # Decode-pipeline metrics (ISSUE 4: device-side termination +
         # deep chunk pipelining). Occupancy/config are gauges sampled at
@@ -672,6 +689,10 @@ class Metrics:
             sharding.get("residual_tp_fraction", 0.0))
         self.kv_pool_mesh_fallback.set(
             1 if sharding.get("kv_pool_mesh_fallback") else 0)
+        self.spec_draft_sharded.set(
+            1 if sharding.get("draft_sharded") else 0)
+        self.spec_draft_kv_fallback.set(
+            1 if sharding.get("draft_kv_fallback") else 0)
 
     def observe_containment(self, stats: dict) -> None:
         """Delta-mirror the engine supervisor's containment totals

@@ -9,7 +9,8 @@ transcripts BYTE-identical at temperature 0 and seeded 0.9 on the
 the f≈1 residual sharding policy (norms/RoPE/sampling scratch batch-shard
 across the TP group, collectives fused at the GEMM boundaries and kept
 scan-resident), the loud dense fallback for data/pipe/seq meshes, the
-SPEC_DECODE+mesh refusal, replicated grammar tables, the sharding
+SPEC_DECODE+mesh capability check (tp/ep compose since ISSUE 18;
+data/pipe/seq refuse), replicated grammar tables, the sharding
 /health + /metrics surfaces, the v2 ``all_reduce`` attribution category,
 and tp_projection's measured re-pricing mode.
 """
@@ -162,25 +163,36 @@ async def test_pool_falls_back_dense_under_dp_mesh_loudly():
         await eng.stop()
 
 
-# --------------------------------------------- spec + mesh must refuse
+# ------------------------------- spec + mesh capability check (ISSUE 18)
 
 
-def test_spec_decode_refuses_multi_device_mesh_at_config():
+def test_spec_decode_accepts_tp_mesh_refuses_unshardable_axes():
+    """The ISSUE 14 blanket refusal is lifted: SPEC_DECODE composes
+    with tensor/expert-parallel meshes (the draft world is sharded);
+    only genuinely unshardable axes — data/pipe/seq, where the spec
+    pool's shared blocks and the whole-stack draft can't follow —
+    still refuse, at config AND at direct engine construction."""
     from ai_agent_kubectl_tpu.config import ServiceConfig
 
-    with pytest.raises(ValueError, match="SPEC_DECODE.*mesh"):
-        ServiceConfig(spec_decode=True, mesh_shape="tp=8",
-                      spec_draft_model="toy-8m")
-    with pytest.raises(ValueError, match="SPEC_DECODE.*mesh"):
-        ServiceConfig(spec_decode=True, mesh_shape="tp=2",
-                      dcn_mesh_shape="dp=2", spec_draft_model="toy-8m")
-    # Single-device mesh strings stay legal (nothing is partitioned).
+    # tp/ep meshes now validate (deep detailed checks are the
+    # engine's, at start — config stays jax-free).
+    ServiceConfig(spec_decode=True, mesh_shape="tp=8",
+                  spec_draft_model="toy-8m")
+    ServiceConfig(spec_decode=True, mesh_shape="tp=2,ep=2",
+                  spec_draft_model="toy-8m")
     ServiceConfig(spec_decode=True, mesh_shape="tp=1",
                   spec_draft_model="toy-8m")
+    # data/pipe/seq axes (any alias, either mesh knob) refuse loudly.
+    for kw in (dict(mesh_shape="dp=2"), dict(mesh_shape="pp=2"),
+               dict(mesh_shape="seq=2"), dict(mesh_shape="tp=2,dp=2"),
+               dict(mesh_shape="tp=2", dcn_mesh_shape="dp=2")):
+        with pytest.raises(ValueError, match="SPEC_DECODE.*mesh"):
+            ServiceConfig(spec_decode=True, spec_draft_model="toy-8m",
+                          **kw)
 
 
-async def test_spec_decode_refuses_multi_device_mesh_at_start():
-    eng = _mk("tp=2", spec_decode=True, spec_draft_model="toy-8m")
+async def test_spec_decode_refuses_unshardable_mesh_at_start():
+    eng = _mk("dp=2", spec_decode=True, spec_draft_model="toy-8m")
     with pytest.raises(ValueError, match="SPEC_DECODE"):
         await eng.start()
 

@@ -67,6 +67,19 @@ METRICS: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = (
      ("extra", "step_time", "decode_p50_ms")),
     ("gemma_7b.step_time.decode_p50_ms", "steptime",
      ("extra", "gemma_7b", "step_time", "decode_p50_ms")),
+    # Spec×TP sweep (ISSUE 18): speculative decoding under the tp=8
+    # mesh, keyed per-bs so the dict walk reaches each rung. Once a
+    # trajectory artifact records these, the composition is REQUIRED —
+    # a vanished or timed-out tp_spec7b phase fails as
+    # absent/timed_out, never as a silent pass.
+    ("gemma_7b.tp_spec.bs48.tok_s_chip", "throughput",
+     ("extra", "gemma_7b", "tp_spec_sweep", "bs48", "tok_s_chip")),
+    ("gemma_7b.tp_spec.bs192.tok_s_chip", "throughput",
+     ("extra", "gemma_7b", "tp_spec_sweep", "bs192", "tok_s_chip")),
+    ("gemma_7b.tp_spec.bs48.spec_step_ms", "steptime",
+     ("extra", "gemma_7b", "tp_spec_sweep", "bs48", "spec_step_ms")),
+    ("gemma_7b.tp_spec.bs192.spec_step_ms", "steptime",
+     ("extra", "gemma_7b", "tp_spec_sweep", "bs192", "spec_step_ms")),
 )
 
 

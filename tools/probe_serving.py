@@ -192,6 +192,12 @@ def print_mesh_summary(gauges: Dict[str, float]) -> None:
     log(f"  residual TP fraction (f)    {frac:>8.2f}")
     log(f"  kv pool mesh fallback       "
         f"{'YES (dense ladder!)' if fallback else 'no':>8}")
+    # Spec×TP (ISSUE 18): whether the draft world rides this mesh
+    # sharded, and whether its KV serves replicated (gather fallback).
+    log(f"  draft sharded               "
+        f"{'yes' if gauges.get('spec_draft_sharded') else 'no':>8}")
+    log(f"  draft kv fallback           "
+        f"{'YES (gathered!)' if gauges.get('spec_draft_kv_fallback') else 'no':>8}")
 
 
 def print_kv_pool_summary(gauges: Dict[str, float]) -> None:
@@ -396,7 +402,11 @@ def print_spec_summary(gauges: Dict[str, float]) -> None:
     log(f"  {'drafted':>12} {'accepted':>12} {'rejected':>12} "
         f"{'acceptance':>12}")
     log(f"  {drafted:>12.0f} {accepted:>12.0f} "
-        f"{drafted - accepted:>12.0f} {ratio:>11.1%}")
+        f"{drafted - accepted:>12.0f} {ratio:>11.1%}"
+        + ("  [draft sharded]"
+           if gauges.get("spec_draft_sharded") else "")
+        + ("  [draft KV GATHERED]"
+           if gauges.get("spec_draft_kv_fallback") else ""))
 
 
 def print_slo_summary(gauges: Dict[str, float]) -> None:

@@ -160,6 +160,102 @@ def render_measured(a, rungs: list) -> str:
     return "\n".join(lines)
 
 
+def _descend(node: dict, *keys: str) -> dict:
+    """Walk driver-wrapper / orchestrator nesting levels that may or
+    may not be present (BENCH_r*.json wraps the orchestrator dict in
+    ``parsed``; phases nest under ``extra.gemma_7b``)."""
+    for key in keys:
+        if isinstance(node, dict) and key in node:
+            node = node[key]
+    return node
+
+
+def extract_acceptance(bench: dict):
+    """Pull the measured spec acceptance out of a bench artifact:
+    prefer a ``tp_spec_sweep`` rung (acceptance measured UNDER the
+    mesh, and carrying the measured spec step), else the plain
+    ``spec_sweep``'s highest-k rung. Returns None when the artifact
+    carries neither — the composed table then refuses to print rather
+    than compose with an invented ratio."""
+    node = _descend(bench, "parsed", "extra", "gemma_7b")
+    if not isinstance(node, dict):
+        return None
+    best = None
+    for key, r in (node.get("tp_spec_sweep") or {}).items():
+        if (isinstance(r, dict)
+                and r.get("acceptance_ratio") is not None):
+            best = {"acceptance": float(r["acceptance_ratio"]),
+                    "k": int(r.get("spec_k", 4)),
+                    "source": f"tp_spec_sweep.{key}",
+                    "spec_step_ms": r.get("spec_step_ms"),
+                    "bs": r.get("bs")}
+    if best is not None:
+        return best
+    for key, r in sorted((node.get("spec_sweep") or {}).items()):
+        if (isinstance(r, dict) and key.startswith("k")
+                and r.get("acceptance_ratio") is not None):
+            try:
+                k = int(key[1:].split("_")[0])
+            except ValueError:
+                continue
+            if best is None or k >= best["k"]:
+                best = {"acceptance": float(r["acceptance_ratio"]),
+                        "k": k, "source": f"spec_sweep.{key}",
+                        "spec_step_ms": None, "bs": None}
+    return best
+
+
+def render_acceptance(a, acc: dict, rungs: list, out: dict) -> str:
+    """The Spec×TP composed section (ISSUE 18): the measured TP step
+    price x the measured acceptance ratio, derived in one place so
+    BASELINE.md quotes arithmetic instead of an adjective.
+
+    Per verify window the mesh pays one (k+1)-wide target step (the
+    memory-bound weight stream is read once, same as a decode step)
+    plus k+1 draft single-token steps at ``--draft-step-ratio`` r of
+    the target's, and buys 1 + a·k transcript tokens:
+
+        window_ms   = step_tp_ms · (1 + r·(k+1))
+        tok/s/chip  = bs / window_ms · (1 + a·k) · 1e3 / tp
+
+    Rows come from the measured tp_sweep rungs when present, else the
+    f=1.0 projection rows; a rung that carried its own MEASURED
+    spec_step_ms (bench --phase tp_spec7b) is quoted directly."""
+    ar, k, r = acc["acceptance"], acc["k"], a.draft_step_ratio
+    mult = (1.0 + ar * k) / (1.0 + r * (k + 1))
+    lines = [
+        "",
+        f"Spec×TP composed (measured acceptance a={ar:.2f} at k={k} "
+        f"from {acc['source']}; draft/target step ratio r={r}): "
+        f"1 + a·k = {1 + ar * k:.2f} tokens bought per verify window "
+        f"at {1 + r * (k + 1):.2f}× the step price — multiplier "
+        f"×{mult:.2f} on the TP rung:",
+        "",
+        "| bs | TP step ms | window ms | tok/window | tok/s/chip "
+        "(composed) |",
+        "|---|---|---|---|---|",
+    ]
+    if rungs:
+        rows = [(int(rg["bs"]), float(rg["step_ms"])) for rg in rungs]
+    else:
+        rows = [(rr["bs"], rr["step_ms"]) for rr in out["rows"]
+                if rr["f"] == 1.0]
+    for bs, step in rows:
+        window = step * (1.0 + r * (k + 1))
+        lines.append(
+            f"| {bs} | {step:.2f} | {window:.2f} "
+            f"| {1 + ar * k:.2f} "
+            f"| **{bs / window * 1e3 / a.tp * (1 + ar * k):.0f}** |")
+    if acc.get("spec_step_ms") and acc.get("bs"):
+        sm, bs = float(acc["spec_step_ms"]), int(acc["bs"])
+        lines.append(
+            f"\nMeasured spec window (bench --phase tp_spec7b, "
+            f"bs={bs}): {sm:.2f} ms → "
+            f"**{bs / sm * 1e3 / a.tp * (1 + ar * k):.0f}** "
+            f"tok/s/chip at the measured acceptance.")
+    return "\n".join(lines)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--attribution", default=None,
@@ -174,6 +270,17 @@ def main() -> int:
                     help="one measured sharded step in ms (with "
                          "--measured-bs) instead of --measured-json")
     ap.add_argument("--measured-bs", type=int, default=192)
+    ap.add_argument("--acceptance", default=None,
+                    help="bench artifact carrying a measured spec "
+                         "acceptance ratio (spec_sweep or "
+                         "tp_spec_sweep); adds the Spec×TP composed "
+                         "section — the TP step price x the measured "
+                         "acceptance (ISSUE 18)")
+    ap.add_argument("--draft-step-ratio", type=float, default=0.27,
+                    help="draft step cost as a fraction of the "
+                         "target's (2B int8 weight stream ~2.5 GB vs "
+                         "the 7B's 9.35 GB; both shard by tp, so the "
+                         "ratio survives the mesh)")
     ap.add_argument("--measured-allreduce", type=float, default=None,
                     help="measured all-reduce ms within the sharded "
                          "step (attribution category; default: the "
@@ -266,6 +373,15 @@ def main() -> int:
                     a.tp, int(r["bs"]) * a.dim * a.dtype_bytes,
                     a.ici_gbps, a.ici_latency_us)
         print(render_measured(a, rungs))
+
+    if a.acceptance:
+        with open(a.acceptance) as f:
+            acc = extract_acceptance(json.load(f))
+        if acc is None:
+            print(f"# no spec_sweep/tp_spec_sweep acceptance in "
+                  f"{a.acceptance}", file=sys.stderr)
+        else:
+            print(render_acceptance(a, acc, rungs, out))
     return 0
 
 
