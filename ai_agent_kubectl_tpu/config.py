@@ -248,6 +248,18 @@ class ServiceConfig:
     # chip serves the real EP program); "dense" forces all-experts.
     moe_impl: str = "auto"                  # MOE_IMPL: auto | ep | dense
     kv_page_size: int = 16                  # KV_PAGE_SIZE (paged attention)
+    # Ragged paged attention (ISSUE 19; ops/ragged_attention.py): ONE
+    # kernel over the block pool serves decode (q_len=1), spec verify
+    # (q_len=k+1), and admission suffix prefill (q_len=prompt-span), so
+    # a mixed prefill+decode+verify chunk is one program dispatch and
+    # the (bucket, kv_limit) pool-prefill program ladder collapses.
+    # "auto" = on in pool mode on TPU (CPU keeps the legacy ladder —
+    # interpret-mode Pallas has a different cost model); "off" = the
+    # legacy three-regime world for A/B. Falls back loudly (the
+    # attention_regime health field / decode_attention_regime gauge)
+    # when KV is int8-quantized or KV heads don't divide the model
+    # axis.
+    ragged_attention: str = "auto"          # RAGGED_ATTENTION: auto | on | off
     # --- block-paged KV pool + radix prefix sharing (ISSUE 10) ---
     # Replace per-slot dense KV (every request owning an S_alloc-row
     # region — the thing that capped the batch at bs=64 on 7B int8) with
@@ -629,6 +641,19 @@ class ServiceConfig:
             raise ValueError(
                 f"RADIX_LRU_BLOCKS must be >= 0 (0 = auto), "
                 f"got {self.radix_lru_blocks}")
+        # Ragged attention knob (ISSUE 19): a typo'd mode must refuse
+        # to boot, not silently serve the legacy ladder behind a knob
+        # that says otherwise. "on" additionally needs the pool (ragged
+        # is a kernel OVER the block pool — there is no dense variant).
+        if self.ragged_attention not in ("auto", "on", "off"):
+            raise ValueError(
+                f"RAGGED_ATTENTION must be auto|on|off, "
+                f"got {self.ragged_attention!r}")
+        if self.ragged_attention == "on" and not self.kv_pool:
+            raise ValueError(
+                "RAGGED_ATTENTION=on requires KV_POOL=true (the ragged "
+                "kernel reads per-slot block tables over the shared "
+                "pool — the dense ladder has no ragged variant)")
         # Grammar knobs (ISSUE 11): a typo'd profile or an impossible
         # mode combination must refuse to boot, not silently serve
         # unconstrained output behind a knob that says otherwise.
@@ -792,6 +817,8 @@ class ServiceConfig:
             decode_attn=(_env_str("DECODE_ATTN", "auto") or "auto").lower(),
             moe_impl=(_env_str("MOE_IMPL", "auto") or "auto").lower(),
             kv_page_size=_env_int("KV_PAGE_SIZE", 16),
+            ragged_attention=(_env_str("RAGGED_ATTENTION", "auto")
+                              or "auto").lower(),
             kv_pool=_env_bool("KV_POOL", True),
             kv_pool_page=_env_int("KV_POOL_PAGE", 16),
             kv_pool_blocks=_env_int("KV_POOL_BLOCKS", 0),

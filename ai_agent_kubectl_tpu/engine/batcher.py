@@ -125,7 +125,9 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
                               grammar_s_max: int = 0,
                               spec_k: int = 0,
                               spec_steps: int = 0,
-                              draft_forward_step=None):
+                              draft_forward_step=None,
+                              ragged_w: int = 0,
+                              ragged_forward_step=None):
     """Build THE device-termination decode-chunk body: a ``lax.scan`` of
     ``chunk_len`` steps whose carry folds EOS + per-slot token budgets
     into the live mask (finished slots stop sampling, KV writes, and
@@ -186,20 +188,119 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
     wider row and the two v3 drafted/accepted lanes. EOS / budget /
     health / grammar folds run per verify position — the SAME fold the
     plain body runs per step — which is what makes spec-on transcripts
-    byte-identical to spec-off at any k."""
+    byte-identical to spec-off at any k.
+
+    Ragged admission (ISSUE 19, ``ragged_w > 0``): the chunk grows a
+    trailing ``adm`` argument tuple — per-slot staged prompt-suffix
+    windows ``(tok [N, W], len, start, ngen0, budget, seed, temp[, gs])``
+    — and a PROLOGUE step before the scan: one
+    ``ragged_forward_step(params, win_tok, win_pos, cache, wmask,
+    tables, q_lens)`` call through the ragged paged-attention kernel
+    where a staged slot's q_len is its suffix length and every other
+    live slot rides along at q_len=1 (its normal decode step). The
+    prologue ARMS staged slots in-chunk (seeds/temps/budget/ngen/gs
+    splice from the adm vectors — exactly what ``_run_arm`` +
+    ``_grammar_first_sample`` did host-side, same fold_in indices) and
+    then runs the SAME per-token fold on the last-position logits, so
+    mixed prefill+decode(+spec-verify) slots execute in ONE program
+    dispatch. The plain scan shortens by one step (row width stays
+    chunk_len); the spec buffer widens by one row (ct =
+    spec_steps*(k+1)+1)."""
+
+    def ragged_prologue(params, adm, tok, pos, cache, seeds, temps,
+                        live, ngen, budget, corrupt, tables, gs, tc,
+                        g_ok, g_next):
+        """One ragged mixed-window step (ISSUE 19): staged slots
+        prefill their prompt suffix (q_len = window length) and sample
+        their FIRST token off the last valid position's logits — the
+        device-side equivalent of ``_pool_prefill_span`` +
+        ``_grammar_first_sample`` — while every other live slot rides
+        the same program at q_len=1 (its normal decode step). The fold
+        below mirrors ``body``'s position-for-position (see the NOTE
+        there); ``wrote`` is live-after-freeze-before-EOS — the spec
+        buffer's write gate."""
+        is_adm = adm[1] > 0
+        cols = jnp.arange(ragged_w, dtype=jnp.int32)[None, :]
+        q_len = jnp.where(is_adm, adm[1], 1)
+        start = jnp.where(is_adm, adm[2], pos[:, 0])
+        win_tok = adm[0].at[:, 0].set(
+            jnp.where(is_adm, adm[0][:, 0], tok[:, 0]))
+        win_pos = start[:, None] + cols
+        wmask = jnp.logical_and(cols < q_len[:, None], live[:, None])
+        logits, cache = ragged_forward_step(
+            params, win_tok, win_pos, cache, wmask, tables,
+            jnp.where(live, q_len, 0))
+        step_logits = logits[:, 0]
+        step_logits = jnp.where(corrupt[:, None],
+                                jnp.float32(jnp.nan), step_logits)
+        health = jnp.zeros_like(ngen)
+        mask = None
+        if grammar:
+            with jax.named_scope("grammar_mask"):
+                mask = jnp.take_along_axis(g_ok[gs], tc, axis=1)
+                dead = jnp.logical_and(
+                    live, jnp.logical_not(jnp.any(mask, axis=-1)))
+                health = health | jnp.where(
+                    dead, HEALTH_GRAMMAR_DEAD, 0)
+                live = jnp.logical_and(live, jnp.logical_not(dead))
+        nxt = sample_tokens_seeded(step_logits, seeds, ngen, temps,
+                                   top_k=top_k, top_p=top_p,
+                                   active=live, mask=mask)
+        with jax.named_scope("sampling"):
+            if health_check:
+                bad_logit = jnp.logical_not(
+                    jnp.all(jnp.isfinite(step_logits), axis=-1))
+                health = health | jnp.where(
+                    jnp.logical_and(live, bad_logit),
+                    HEALTH_NONFINITE, 0)
+                if vocab_size > 0:
+                    bad_tok = jnp.logical_or(nxt < 0,
+                                             nxt >= vocab_size)
+                    health = health | jnp.where(
+                        jnp.logical_and(live, bad_tok),
+                        HEALTH_TOKEN_RANGE, 0)
+                live = jnp.logical_and(live, health == 0)
+            if grammar:
+                cls = jnp.take_along_axis(
+                    tc, jnp.clip(nxt, 0, tc.shape[1] - 1)[:, None],
+                    axis=1)[:, 0]
+                gs = jnp.where(live, g_next[gs, cls], gs)
+            nxt = jnp.where(live, nxt, win_tok[:, 0])
+            wrote = live
+            hit_eos = jnp.logical_and(eos_mask(nxt, eos_ids), live)
+            counted = jnp.logical_and(live, jnp.logical_not(hit_eos))
+            ngen = ngen + counted.astype(jnp.int32)
+            done_now = jnp.logical_or(
+                hit_eos, jnp.logical_and(counted, ngen >= budget))
+            live = jnp.logical_and(live, jnp.logical_not(done_now))
+            pos = (start + q_len * counted.astype(jnp.int32))[:, None]
+        return nxt, pos, cache, live, ngen, health, gs, counted, wrote
 
     def batched_chunk_impl(params, tok, pos, cache, seeds, temps, force,
                            active, ngen, budget, corrupt, tables=None,
                            gs=None, g_tok_class=None, g_ok=None,
-                           g_next=None):
+                           g_next=None, adm=None):
         # NOTE: the per-step termination/health/grammar/EOS/budget fold
         # in ``body`` below is mirrored position-for-position by
-        # ``spec_chunk_impl``'s verify loop (and by the fake engine's
-        # two dispatch paths). Any change to the fold's ordering or
-        # semantics MUST be applied to all of them — the spec-on ==
-        # spec-off byte-identity suites (tests/test_spec_decode.py,
-        # fake and jax, temp 0 and 0.9) are the tripwire that catches a
-        # divergence.
+        # ``spec_chunk_impl``'s verify loop, the two ragged PROLOGUES,
+        # and the fake engine's dispatch paths. Any change to the
+        # fold's ordering or semantics MUST be applied to all of them —
+        # the spec-on == spec-off and ragged-vs-legacy byte-identity
+        # suites (tests/test_spec_decode.py, tests/
+        # test_ragged_attention.py, fake and jax, temp 0 and 0.9) are
+        # the tripwire that catches a divergence.
+        if adm is not None:
+            # Ragged arming: splice the staged slots' sampling state in
+            # BEFORE live0/tc derive from it — device-side what
+            # _run_arm's .at[slot].set() writes did between chunks.
+            is_adm = adm[1] > 0
+            seeds = jnp.where(is_adm, adm[5], seeds)
+            temps = jnp.where(is_adm, adm[6], temps)
+            budget = jnp.where(is_adm, adm[4], budget)
+            ngen = jnp.where(is_adm, adm[3], ngen)
+            active = jnp.where(is_adm, adm[4] > adm[3], active)
+            if grammar:
+                gs = jnp.where(is_adm, adm[7], gs)
         live0 = jnp.logical_and(active, force)
         health0 = jnp.zeros_like(ngen)
         tc = None
@@ -289,15 +390,29 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
                         gs), nxt
             return (nxt[:, None], pos, cache, live, ngen, health), nxt
 
+        nxt0 = None
+        if adm is not None:
+            # Ragged prologue replaces the scan's first step: same row
+            # width (chunk_len), one fewer scan iteration.
+            (nxt0, pos, cache, live0, ngen, health0, gs, _c0,
+             _w0) = ragged_prologue(params, adm, tok, pos, cache,
+                                    seeds, temps, live0, ngen, budget,
+                                    corrupt, tables, gs, tc, g_ok,
+                                    g_next)
+            tok = nxt0[:, None]
         carry0 = (tok, pos, cache, live0, ngen, health0)
         if grammar:
             carry0 = carry0 + (gs,)
-        carry, toks = jax.lax.scan(body, carry0, None, length=chunk_len)
+        carry, toks = jax.lax.scan(
+            body, carry0, None,
+            length=chunk_len - (1 if adm is not None else 0))
         if grammar:
             tok, pos, cache, live, ngen, health, gs = carry
         else:
             tok, pos, cache, live, ngen, health = carry
         toks = jnp.swapaxes(toks, 0, 1)
+        if nxt0 is not None:
+            toks = jnp.concatenate([nxt0[:, None], toks], axis=1)
         done = jnp.logical_and(force, jnp.logical_not(live))
         packed = finalize(pack_chunk(toks, done, ngen, jnp.sum(live),
                                      health=health, xp=jnp))
@@ -309,24 +424,70 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
     def spec_chunk_impl(params, tok, pos, cache, seeds, temps, force,
                         active, ngen, budget, corrupt, tables, dparams,
                         dcache, gs=None, g_tok_class=None, g_ok=None,
-                        g_next=None):
+                        g_next=None, adm=None):
         """Draft/verify scan body (ISSUE 12). Carry adds the draft KV
         cache, the compacting token buffer + per-slot cursor, and the
         drafted/accepted counters; everything else mirrors the plain
         body position-for-position."""
         k = spec_k
         N = force.shape[0]
-        CT = spec_steps * (k + 1)
+        # Ragged admission widens the row by the prologue's one token
+        # (ct = spec_steps*(k+1) + 1); CT doubles as the compact
+        # write's out-of-bounds drop sentinel, so buffer width and
+        # sentinel move together by construction.
+        CT = spec_steps * (k + 1) + (1 if adm is not None else 0)
+        if adm is not None:
+            is_adm = adm[1] > 0
+            seeds = jnp.where(is_adm, adm[5], seeds)
+            temps = jnp.where(is_adm, adm[6], temps)
+            budget = jnp.where(is_adm, adm[4], budget)
+            ngen = jnp.where(is_adm, adm[3], ngen)
+            active = jnp.where(is_adm, adm[4] > adm[3], active)
+            if grammar:
+                gs = jnp.where(is_adm, adm[7], gs)
         live0 = jnp.logical_and(active, force)
         health0 = jnp.zeros_like(ngen)
         zeros = jnp.zeros_like(ngen)
-        # Garbage row entries repeat the slot's carry token (the packed
-        # contract): initialize the whole buffer with it — un-written
-        # positions then satisfy "never an accidental EOS at index v".
-        buf0 = jnp.tile(tok, (1, CT))
         tc = None
         if grammar:
             tc = g_tok_class[gs // grammar_s_max]
+        if adm is not None:
+            # Keep the draft cache gapless: the prologue's decode step
+            # advances the target without a draft forward, which would
+            # leave a zero row the next iteration's drafts attend
+            # through (proposal quality only — verify is exact — but a
+            # free single-token draft forward closes it; for a staged
+            # slot it rewrites the admission draft-prefill's own row
+            # with the same token).
+            wt0 = jnp.where(is_adm, adm[0][:, 0], tok[:, 0])
+            st0 = jnp.where(is_adm, adm[2], pos[:, 0])
+            _dl, dcache = draft_forward_step(
+                dparams, wt0[:, None], st0[:, None], dcache, live0)
+            (nxt0, pos, cache, live0, ngen, health0, gs, c0,
+             w0) = ragged_prologue(params, adm, tok, pos, cache,
+                                   seeds, temps, live0, ngen, budget,
+                                   corrupt, tables, gs, tc, g_ok,
+                                   g_next)
+            # Carry-token semantics match the verify fold's ``cur``:
+            # an un-counted prologue (EOS / frozen) keeps the window's
+            # first token as carry — EOS never becomes a spec carry.
+            tok = jnp.where(c0, nxt0, wt0)[:, None]
+            # Garbage row entries repeat the slot's carry token (the
+            # packed contract); the prologue token lands at index 0
+            # for every row that really sampled (EOS included — the
+            # finish-reason entry), and the cursor advances only for
+            # counted ones.
+            buf0 = jnp.tile(tok, (1, CT))
+            buf0 = buf0.at[jnp.arange(N),
+                           jnp.where(w0, 0, CT)].set(nxt0, mode="drop")
+            cur0 = c0.astype(jnp.int32)
+        else:
+            # Garbage row entries repeat the slot's carry token (the
+            # packed contract): initialize the whole buffer with it —
+            # un-written positions then satisfy "never an accidental
+            # EOS at index v".
+            buf0 = jnp.tile(tok, (1, CT))
+            cur0 = zeros
 
         def body(carry, _):
             if grammar:
@@ -464,7 +625,7 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
             return out, None
 
         carry0 = (tok, pos, cache, dcache, live0, ngen, health0, buf0,
-                  zeros, zeros, zeros)
+                  cur0, zeros, zeros)
         if grammar:
             carry0 = carry0 + (gs,)
         carry, _ = jax.lax.scan(body, carry0, None, length=spec_steps)
@@ -483,10 +644,47 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
             out = out + (gs,)
         return out
 
+    if ragged_w:
+        if not pool_tables or ragged_forward_step is None:
+            raise ValueError("ragged admission chunk needs pool "
+                             "tables and a ragged_forward_step")
+
     if spec_k > 0:
         if not pool_tables or draft_forward_step is None:
             raise ValueError("speculative decode chunk needs pool "
                              "tables and a draft_forward_step")
+        if ragged_w and grammar:
+            def spec_chunk_ragged_grammar(params, tok, pos, cache,
+                                          seeds, temps, force, active,
+                                          ngen, budget, corrupt, tables,
+                                          dparams, dcache, gs,
+                                          g_tok_class, g_ok, g_next,
+                                          adm_tok, adm_len, adm_start,
+                                          adm_ngen0, adm_budget,
+                                          adm_seed, adm_temp, adm_gs):
+                return spec_chunk_impl(
+                    params, tok, pos, cache, seeds, temps, force,
+                    active, ngen, budget, corrupt, tables, dparams,
+                    dcache, gs, g_tok_class, g_ok, g_next,
+                    adm=(adm_tok, adm_len, adm_start, adm_ngen0,
+                         adm_budget, adm_seed, adm_temp, adm_gs))
+
+            return spec_chunk_ragged_grammar
+        if ragged_w:
+            def spec_chunk_ragged(params, tok, pos, cache, seeds,
+                                  temps, force, active, ngen, budget,
+                                  corrupt, tables, dparams, dcache,
+                                  adm_tok, adm_len, adm_start,
+                                  adm_ngen0, adm_budget, adm_seed,
+                                  adm_temp):
+                return spec_chunk_impl(
+                    params, tok, pos, cache, seeds, temps, force,
+                    active, ngen, budget, corrupt, tables, dparams,
+                    dcache,
+                    adm=(adm_tok, adm_len, adm_start, adm_ngen0,
+                         adm_budget, adm_seed, adm_temp))
+
+            return spec_chunk_ragged
         if grammar:
             def spec_chunk_pool_grammar(params, tok, pos, cache, seeds,
                                         temps, force, active, ngen,
@@ -509,6 +707,37 @@ def make_termination_chunk_fn(forward_step, chunk_len: int, eos_ids,
                                    corrupt, tables, dparams, dcache)
 
         return spec_chunk_pool
+
+    if ragged_w and grammar:
+        def batched_chunk_ragged_grammar(params, tok, pos, cache, seeds,
+                                         temps, force, active, ngen,
+                                         budget, corrupt, tables, gs,
+                                         g_tok_class, g_ok, g_next,
+                                         adm_tok, adm_len, adm_start,
+                                         adm_ngen0, adm_budget,
+                                         adm_seed, adm_temp, adm_gs):
+            return batched_chunk_impl(
+                params, tok, pos, cache, seeds, temps, force, active,
+                ngen, budget, corrupt, tables, gs, g_tok_class, g_ok,
+                g_next,
+                adm=(adm_tok, adm_len, adm_start, adm_ngen0,
+                     adm_budget, adm_seed, adm_temp, adm_gs))
+
+        return batched_chunk_ragged_grammar
+
+    if ragged_w:
+        def batched_chunk_ragged(params, tok, pos, cache, seeds, temps,
+                                 force, active, ngen, budget, corrupt,
+                                 tables, adm_tok, adm_len, adm_start,
+                                 adm_ngen0, adm_budget, adm_seed,
+                                 adm_temp):
+            return batched_chunk_impl(
+                params, tok, pos, cache, seeds, temps, force, active,
+                ngen, budget, corrupt, tables,
+                adm=(adm_tok, adm_len, adm_start, adm_ngen0,
+                     adm_budget, adm_seed, adm_temp))
+
+        return batched_chunk_ragged
 
     if pool_tables and grammar:
         def batched_chunk_pool_grammar(params, tok, pos, cache, seeds,
@@ -708,6 +937,7 @@ class BatchedJaxEngine(JaxEngine):
 
     def __init__(self, *args, batch_size: int = 8, chunk_len: int = 16,
                  kv_page_size: int = 16, decode_attn: str = "auto",
+                 ragged_attention: str = "auto",
                  kv_pool: bool = True,
                  kv_pool_page: int = 16,
                  kv_pool_blocks: int = 0,
@@ -757,6 +987,10 @@ class BatchedJaxEngine(JaxEngine):
             raise ValueError(
                 f"DECODE_ATTN must be auto|dense|paged, got {decode_attn!r}"
             )
+        if ragged_attention not in ("auto", "on", "off"):
+            raise ValueError(
+                f"RAGGED_ATTENTION must be auto|on|off, "
+                f"got {ragged_attention!r}")
         self.batch_size = batch_size
         self.chunk_len = chunk_len
         # Speculative decode chunks kept in flight ahead of the consumer.
@@ -810,6 +1044,26 @@ class BatchedJaxEngine(JaxEngine):
         self._radix: Optional[RadixCache] = None
         self._pool_prefill_fns: dict = {}   # (bucket, kv_limit) -> jitted
         self._pool_starved = 0        # slots truncated by pool exhaustion
+        # Ragged paged attention (ISSUE 19): ONE Pallas kernel serves
+        # decode (q_len=1), spec verify (q_len=k+1), and admission
+        # suffix prefill (q_len=prompt-span) over the block pool, so a
+        # mixed prefill+decode+verify chunk is one program dispatch and
+        # the (bucket, kv_limit) pool-prefill ladder collapses. "auto"
+        # = on in pool mode on TPU (CPU keeps the ladder — interpret-
+        # mode Pallas has a different cost model; tests force "on").
+        # "off" = the legacy three-regime world, kept for A/B.
+        self.ragged_attention = ragged_attention
+        self._use_ragged = False      # resolved at start (pool/TPU gate)
+        # ragged | paged | gather | dense — the regime actually serving
+        # decode attention, surfaced in sharding_health/kv_pool_health
+        # and the decode_attention_regime gauge so fallbacks (int8 KV,
+        # non-dividing tp) are observable instead of inferred.
+        self._attention_regime = "dense"
+        self._ragged_chunk_fns: dict = {}   # (adm width, spec) -> jitted
+        # slot_idx -> staged admission (ids/start/ngen0/budget/seed/
+        # temp/gs): the unmatched prompt suffix rides the NEXT chunk as
+        # a long-q_len slot instead of a separately compiled prefill.
+        self._pending_adm: dict = {}
         # Grammar-constrained decoding (ISSUE 11): the kubectl token
         # FSM masks sampling device-side and forced runs fast-forward
         # as suffix prefills. Requires device termination (the FSM
@@ -1048,6 +1302,7 @@ class BatchedJaxEngine(JaxEngine):
             chunk_pipe_depth=cfg.chunk_pipe_depth,
             kv_page_size=cfg.kv_page_size,
             decode_attn=cfg.decode_attn,
+            ragged_attention=cfg.ragged_attention,
             kv_pool=cfg.kv_pool,
             kv_pool_page=cfg.kv_pool_page,
             kv_pool_blocks=cfg.kv_pool_blocks,
@@ -1313,6 +1568,51 @@ class BatchedJaxEngine(JaxEngine):
                     "gather path", cfg.n_kv_heads, cfg.n_heads,
                     self.mesh.shape["model"])
                 decode_impl = "dense"
+            # Ragged paged attention (ISSUE 19): ONE kernel serves
+            # decode, spec verify, AND admission suffix prefill, so the
+            # spec gate below never fires and the (bucket, kv_limit)
+            # prefill ladder collapses. auto = on under the same
+            # TPU-backend rule as resolve_decode_attn (interpret-mode
+            # Pallas on CPU has a different cost model; tests force
+            # "on"); every fallback is LOUD and lands in
+            # _attention_regime.
+            use_ragged = (self.ragged_attention == "on"
+                          or (self.ragged_attention == "auto"
+                              and jax.default_backend() == "tpu"))
+            if use_ragged and not self.device_termination:
+                logger.warning(
+                    "RAGGED_ATTENTION needs DEVICE_TERMINATION (staged "
+                    "admissions arm inside the chunk carry); serving "
+                    "the legacy ladder")
+                use_ragged = False
+            if use_ragged and self.kv_quant:
+                logger.warning(
+                    "RAGGED_ATTENTION: the ragged pool kernel reads "
+                    "bf16 KV; int8 KV serves the gather path")
+                use_ragged = False
+            if use_ragged and jax.default_backend() == "tpu":
+                from ..ops.ragged_attention import ragged_supported
+
+                if not ragged_supported(self.kv_pool_page,
+                                        cfg.head_dim, 1):
+                    logger.warning(
+                        "ragged pool attention unsupported for page=%d "
+                        "head_dim=%d; using the %s path",
+                        self.kv_pool_page, cfg.head_dim, decode_impl)
+                    use_ragged = False
+            if (use_ragged and self.mesh is not None
+                    and self.mesh.shape["model"] > 1
+                    and (cfg.n_kv_heads % self.mesh.shape["model"]
+                         or cfg.n_heads % self.mesh.shape["model"])):
+                logger.warning(
+                    "ragged pool attention needs KV (%d) and H (%d) "
+                    "divisible by the model axis (%d); using the "
+                    "gather path", cfg.n_kv_heads, cfg.n_heads,
+                    self.mesh.shape["model"])
+                use_ragged = False
+            self._use_ragged = use_ragged
+            if use_ragged:
+                decode_impl = "ragged"
             if decode_impl == "paged" and self._use_spec:
                 # The verify step is a (k+1)-token window — the paged
                 # decode kernel is single-query. Keep the dense gather
@@ -1323,6 +1623,9 @@ class BatchedJaxEngine(JaxEngine):
                             "path")
                 decode_impl = "dense"
             self._decode_impl = decode_impl
+            self._attention_regime = (
+                "ragged" if decode_impl == "ragged"
+                else "paged" if decode_impl == "paged" else "gather")
             # Pool geometry: S_alloc page-rounds so every per-slot table
             # has a whole number of pages; kv buckets are 128-tiled, and
             # the page divides 128 by the constructor check, so every
@@ -1338,12 +1641,16 @@ class BatchedJaxEngine(JaxEngine):
                     f"KV_POOL_BLOCKS={self._pool_n_blocks} cannot hold "
                     f"even one full-length sequence "
                     f"({self._pool_max_pages} pages)")
-            if decode_impl == "paged":
-                # The pallas pool kernel needs no ladder (cost tracks
-                # live pages per slot inside one program) — but PREFILL
-                # still gathers [1, kv_limit] views, so it keeps its own
-                # ladder regardless: a 40-token prompt must not gather
-                # (and attend over) the full S_alloc span.
+            if decode_impl in ("paged", "ragged"):
+                # The pallas pool kernels need no ladder (cost tracks
+                # live pages per slot inside one program) — but under
+                # "paged", PREFILL still gathers [1, kv_limit] views,
+                # so it keeps its own ladder: a 40-token prompt must
+                # not gather (and attend over) the full S_alloc span.
+                # Under "ragged" prefill reads through the SAME kernel
+                # and the prefill ladder collapses to one kv_limit too
+                # (_pool_prefill_span) — the draft model's dense
+                # prefill is the only remaining ladder client.
                 self._kv_buckets = (S_alloc,)
             else:
                 self._kv_buckets = kv_bucket_ladder(S_alloc)
@@ -1384,6 +1691,12 @@ class BatchedJaxEngine(JaxEngine):
                     )
                     decode_impl = "dense"
             self._decode_impl = decode_impl
+            self._attention_regime = (
+                "paged" if decode_impl == "paged" else "dense")
+            if self.ragged_attention == "on":
+                logger.warning(
+                    "RAGGED_ATTENTION=on needs the KV pool; the dense "
+                    "ladder is serving instead")
 
             # Decode-attention cost grows with the KV span it reads.
             # Rather than attending over the full S_alloc cache every
@@ -1547,6 +1860,58 @@ class BatchedJaxEngine(JaxEngine):
                 for b in self._kv_buckets
             }
 
+        def ragged_forward_step_fn(kv_limit):
+            """The prologue's model call: one forward over a [N, W]
+            mixed window through the ragged kernel — per-slot q_lens
+            pick each row's valid prefix, the 2-D write mask gates the
+            KV scatter to exactly those columns, and logits_at keeps
+            only the last valid position's row (the one the fold
+            samples from)."""
+
+            def rstep(params, tok, pos, cache, wmask, tables, q_lens):
+                return forward(params, cfg, tok, pos, cache,
+                               kv_limit=kv_limit,
+                               attn_impl="ragged",
+                               mesh=self.mesh,
+                               moe_impl=self.moe_impl,
+                               token_mask=wmask,
+                               write_mask=wmask,
+                               page_size=self.kv_pool_page,
+                               block_tables=tables,
+                               q_lens=q_lens,
+                               logits_at=jnp.maximum(q_lens, 1) - 1)
+
+            return rstep
+
+        if self._use_ragged:
+            # One ragged mixed-chunk program per ADMISSION WIDTH (the
+            # prefill bucket the staged suffixes pad to) — this set
+            # replaces the legacy (bucket, kv_limit) prefill ladder
+            # (|buckets| x |kv ladder| programs) plus the per-kv-bucket
+            # chunk ladder, which is the compiled-program-count drop
+            # the warmup test asserts. Same donation layout as the
+            # plain set (adm args trail, so the indices hold). The
+            # ``if not in`` guard keeps warm-swap restarts retrace-free
+            # (PR 13).
+            def ragged_chunk_body(adm_w):
+                kvl = self._kv_buckets[-1]
+                return make_termination_chunk_fn(
+                    chunk_forward_step(kvl), self.chunk_len, eos_ids,
+                    self.top_k, self.top_p, vocab_size=cfg.vocab_size,
+                    health_check=self.slot_health_check,
+                    finalize=self._replicated,
+                    pool_tables=True,
+                    grammar=self._grammar is not None,
+                    grammar_s_max=(self._grammar.S_max
+                                   if self._grammar is not None else 0),
+                    ragged_w=adm_w,
+                    ragged_forward_step=ragged_forward_step_fn(kvl))
+
+            for w in self.prefill_buckets:
+                if (w, False) not in self._ragged_chunk_fns:
+                    self._ragged_chunk_fns[(w, False)] = jax.jit(
+                        ragged_chunk_body(w), donate_argnums=donate)
+
         if self._use_spec:
             # Speculative draft/verify chunk programs (ISSUE 12 →
             # ISSUE 18), one per KV bucket beside the plain set — both
@@ -1595,6 +1960,31 @@ class BatchedJaxEngine(JaxEngine):
                     b: jax.jit(spec_chunk_body(b), donate_argnums=sdonate)
                     for b in self._kv_buckets
                 }
+
+            if self._use_ragged:
+                def spec_ragged_body(adm_w):
+                    kvl = self._kv_buckets[-1]
+                    return make_termination_chunk_fn(
+                        chunk_forward_step(kvl), self.chunk_len,
+                        eos_ids, self.top_k, self.top_p,
+                        vocab_size=cfg.vocab_size,
+                        health_check=self.slot_health_check,
+                        finalize=self._replicated,
+                        pool_tables=True,
+                        grammar=self._grammar is not None,
+                        grammar_s_max=(self._grammar.S_max
+                                       if self._grammar is not None
+                                       else 0),
+                        spec_k=self.spec_draft_k,
+                        spec_steps=self._spec_steps,
+                        draft_forward_step=draft_forward_step(kvl),
+                        ragged_w=adm_w,
+                        ragged_forward_step=ragged_forward_step_fn(kvl))
+
+                for w in self.prefill_buckets:
+                    if (w, True) not in self._ragged_chunk_fns:
+                        self._ragged_chunk_fns[(w, True)] = jax.jit(
+                            spec_ragged_body(w), donate_argnums=sdonate)
 
         def splice(cache, src_k, src_v, tok, pos, temps, active, ngen,
                    budget, seeds, slot, n_prompt, first_tok, temperature,
@@ -1941,18 +2331,44 @@ class BatchedJaxEngine(JaxEngine):
         fn = self._pool_prefill_fns.get(key)
         if fn is None:
             cfg = self.model_cfg
-            impl = self._prefill_impl_for(bucket, kv_limit)
+            if self._use_ragged:
+                # Ragged mode (ISSUE 19): the standalone prefill reads
+                # through the SAME kernel as decode — per-row q_lens
+                # pick the valid prefix, the kernel's page clamp bounds
+                # the cost to live pages, and kv_limit collapses to the
+                # single S_alloc rung (_pool_prefill_span), so this set
+                # is one program per bucket instead of
+                # |buckets| x |kv ladder|. The write mask gates padding
+                # columns out of the KV scatter (legacy let them write
+                # garbage at future positions; both are never attended
+                # before being rewritten).
+                def pool_prefill(params, tokens, positions, cache, mask,
+                                 tables):
+                    q_lens = mask.sum(axis=1).astype(jnp.int32)
+                    return forward(params, cfg, tokens, positions,
+                                   cache, kv_limit=kv_limit,
+                                   attn_impl="ragged",
+                                   mesh=self.mesh,
+                                   moe_impl=self.moe_impl,
+                                   token_mask=mask,
+                                   write_mask=mask > 0,
+                                   logits_at=jnp.maximum(q_lens - 1, 0),
+                                   page_size=self.kv_pool_page,
+                                   block_tables=tables,
+                                   q_lens=q_lens)
+            else:
+                impl = self._prefill_impl_for(bucket, kv_limit)
 
-            def pool_prefill(params, tokens, positions, cache, mask,
-                             tables):
-                last = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1,
-                                   0)
-                return forward(params, cfg, tokens, positions, cache,
-                               kv_limit=kv_limit, attn_impl=impl,
-                               mesh=self.mesh, moe_impl=self.moe_impl,
-                               token_mask=mask, logits_at=last,
-                               page_size=self.kv_pool_page,
-                               block_tables=tables)
+                def pool_prefill(params, tokens, positions, cache, mask,
+                                 tables):
+                    last = jnp.maximum(
+                        mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+                    return forward(params, cfg, tokens, positions, cache,
+                                   kv_limit=kv_limit, attn_impl=impl,
+                                   mesh=self.mesh, moe_impl=self.moe_impl,
+                                   token_mask=mask, logits_at=last,
+                                   page_size=self.kv_pool_page,
+                                   block_tables=tables)
 
             fn = jax.jit(pool_prefill, donate_argnums=(3,))
             self._pool_prefill_fns[key] = fn
@@ -2060,7 +2476,13 @@ class BatchedJaxEngine(JaxEngine):
         while offset < n:
             L = min(big, n - offset)
             bucket = next(b for b in self.prefill_buckets if b >= L)
-            kv_limit = self._pool_kv_limit(offset + bucket)
+            # Ragged mode reads through the kernel (cost tracks live
+            # pages, not the gather width): ONE kv rung per bucket,
+            # collapsing the (bucket, kv_limit) program-set keys. The
+            # draft prefill (_draft_prefill_slot) keeps its ladder —
+            # its dense per-slot scratch really does gather kv_limit.
+            kv_limit = (self._S_alloc if self._use_ragged
+                        else self._pool_kv_limit(offset + bucket))
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :L] = ids[offset:offset + L]
             positions = np.broadcast_to(
@@ -2190,10 +2612,48 @@ class BatchedJaxEngine(JaxEngine):
             done_at_admit = run and (len(run) >= req.max_tokens
                                      or ends_eos)
             span = full if not done_at_admit else full[:-1]
-            last_logits = self._pool_prefill_span(
-                self._tables[slot_idx], span, m)
+            staged = None
             first_tok_d = None
-            if not done_at_admit:
+            if not done_at_admit and self._use_ragged:
+                # Ragged admission (ISSUE 19): the unmatched suffix
+                # does NOT run a standalone prefill+sample+arm here —
+                # it stages as a long-q_len window the NEXT chunk's
+                # prologue prefills, samples, and arms in ONE program
+                # with everyone else's decode step (same fold_in
+                # indices and grammar advance as the legacy path —
+                # byte-identical transcripts). Only the head beyond the
+                # widest admission window prefills eagerly.
+                stage_start = max(m, len(span) - self.prefill_buckets[-1])
+                if stage_start > m:
+                    self._pool_prefill_span(
+                        self._tables[slot_idx], span[:stage_start], m)
+                staged = dict(
+                    ids=list(span[stage_start:]),
+                    start=stage_start,
+                    ngen0=len(run),
+                    budget=req.max_tokens,
+                    seed=req.seed,
+                    temp=req.temperature,
+                    gs=gs1,
+                )
+                # Persist the slot's CONFIG vectors (temps/budget/seeds
+                # — read-only chunk inputs, not part of the returned
+                # carry) now: the adm chunk arms its own copies
+                # in-trace, but every LATER chunk reads these buffers.
+                # The token is a placeholder — the prologue overrides
+                # tok/pos/ngen/active for staged slots and the chunk
+                # returns the real carry.
+                self._run_arm(slot_idx, stage_start,
+                              jnp.zeros((1,), jnp.int32),
+                              req.temperature, req.max_tokens, req.seed,
+                              len(run))
+                # The draft still mirrors the FULL span now — the spec
+                # prologue's first in-chunk draft forward reads rows
+                # 0..pos-1 and the draft world has no ragged window.
+                self._draft_prefill_slot(slot_idx, list(span))
+            elif not done_at_admit:
+                last_logits = self._pool_prefill_span(
+                    self._tables[slot_idx], span, m)
                 first_tok_d = self._grammar_first_sample(
                     last_logits, req, gs1, len(run))
                 self._run_arm(slot_idx, n_prompt + len(run), first_tok_d,
@@ -2208,6 +2668,8 @@ class BatchedJaxEngine(JaxEngine):
                 # draft has no radix tree, so it prefills the whole
                 # span (the known spec-decode admission overhead).
                 self._draft_prefill_slot(slot_idx, list(span))
+            else:
+                self._pool_prefill_span(self._tables[slot_idx], span, m)
         except Exception:
             self._tables[slot_idx, :] = self._pool_n_blocks
             self._pool.decref(blocks)
@@ -2220,7 +2682,8 @@ class BatchedJaxEngine(JaxEngine):
             queue_ms=wait_ms,
             t_admit=t_adm,
             t_decode0=t_adm,
-            chunks_inflight=0 if done_at_admit else 1,
+            chunks_inflight=(0 if (done_at_admit or staged is not None)
+                             else 1),
             prefix_hit=m > 0,
             blocks=blocks,
             pool_ids=ids,
@@ -2259,6 +2722,15 @@ class BatchedJaxEngine(JaxEngine):
                          and len(run) < req.max_tokens else "length")
             self._last_admit_t = time.monotonic()
             return
+        if staged is not None:
+            # No "first" pipeline entry: the first sampled token rides
+            # the next chunk's packed buffer (row index 0) and the
+            # consume path's t_first catch covers TTFT. The step-time
+            # sentinel's prefill phase is noted at dispatch, keyed by
+            # the ragged admission width.
+            self._pending_adm[slot_idx] = staged
+            self._last_admit_t = time.monotonic()
+            return
         self._to_host_async(first_tok_d)
         self._inflight.append(("first", first_tok_d, req, slot_idx))
         self._last_admit_t = time.monotonic()
@@ -2291,6 +2763,15 @@ class BatchedJaxEngine(JaxEngine):
             packed = self._run_chunk(kv_b, jnp.zeros((N,), jnp.bool_),
                                      self._no_corrupt_d, tables_d,
                                      spec=False)
+        if self._use_ragged:
+            # Warm the ragged mixed-chunk program per admission width
+            # (ISSUE 19) — an all-zero adm_len tuple compiles the same
+            # program a real staged admission runs.
+            for w in self.prefill_buckets:
+                packed = self._run_chunk(
+                    self._kv_buckets[-1], jnp.zeros((N,), jnp.bool_),
+                    self._no_corrupt_d, tables_d, spec=False,
+                    adm_w=w, adm_args=self._warm_adm_args(w))
         if self._use_spec:
             # Warm the speculative program set beside the plain one
             # (draft:die flips between them mid-serving — neither may
@@ -2302,9 +2783,33 @@ class BatchedJaxEngine(JaxEngine):
                                          jnp.zeros((N,), jnp.bool_),
                                          self._no_corrupt_d, tables_d,
                                          spec=True)
+            if self._use_ragged:
+                for w in self.prefill_buckets:
+                    packed = self._run_chunk(
+                        self._kv_buckets[-1],
+                        jnp.zeros((N,), jnp.bool_),
+                        self._no_corrupt_d, tables_d, spec=True,
+                        adm_w=w, adm_args=self._warm_adm_args(w))
         packed.block_until_ready()
         self._pool.decref(blocks)
         self._pool_preload_system_prompt()
+
+    def _warm_adm_args(self, w: int) -> tuple:
+        """An all-idle staged-admission tuple (adm_len zeros — every
+        slot takes its plain q_len=1 prologue step) with exactly the
+        shapes/dtypes _dispatch_chunk packs, so warmup compiles the
+        program serving will run."""
+        N = self.batch_size
+        args = (jnp.zeros((N, w), jnp.int32),
+                jnp.zeros((N,), jnp.int32),
+                jnp.zeros((N,), jnp.int32),
+                jnp.zeros((N,), jnp.int32),
+                jnp.zeros((N,), jnp.int32),
+                jnp.zeros((N,), jnp.int32),
+                jnp.zeros((N,), jnp.float32))
+        if self._grammar is not None:
+            args = args + (jnp.zeros((N,), jnp.int32),)
+        return args
 
     def _pool_preload_system_prompt(self) -> None:
         """Prefill the shared system prompt once at startup and leave
@@ -2372,6 +2877,10 @@ class BatchedJaxEngine(JaxEngine):
             # off the shard-local fast path; fleets OR this flag).
             "draft_sharded": bool(self._draft_sharded),
             "draft_kv_fallback": bool(self._draft_kv_fallback),
+            # ISSUE 19: the regime actually serving decode attention
+            # (ragged | paged | gather | dense) — int8 KV, non-dividing
+            # head counts, and mesh gates all fall back LOUDLY here.
+            "attention_regime": self._attention_regime,
         }
 
     def kv_pool_health(self) -> Optional[dict]:
@@ -2384,6 +2893,9 @@ class BatchedJaxEngine(JaxEngine):
                   else ())
         body = self._pool.stats(cached).as_dict()
         body["starved_slots_total"] = self._pool_starved
+        # Single-chip deployments read the regime here (sharding_health
+        # is None without a mesh).
+        body["attention_regime"] = self._attention_regime
         body["radix"] = (self._radix.stats() if self._radix is not None
                          else None)
         return body
@@ -3445,6 +3957,9 @@ class BatchedJaxEngine(JaxEngine):
         is salvaged; replay re-derives per-slot state from host truth
         (prompt + emitted tokens + seed)."""
         self._init_decode_state()
+        # Staged ragged admissions die with the device state they were
+        # staged against; replay's fresh _admit_one re-stages them.
+        self._pending_adm.clear()
         self._last_progress = time.monotonic()
 
     def _guarded_replay(self, slot: "_Slot") -> None:
@@ -4502,15 +5017,19 @@ class BatchedJaxEngine(JaxEngine):
                     self._finish(i, "length")
 
     def _run_chunk(self, bucket: int, force_d, corrupt_d,
-                   tables_d=None, spec: Optional[bool] = None):
+                   tables_d=None, spec: Optional[bool] = None,
+                   adm_w: Optional[int] = None, adm_args: tuple = ()):
         """Invoke one decode-chunk program with the mode-correct
         argument tail (pool block tables, speculative draft params +
-        cache, grammar state + tables) and thread the chained device
-        state back — the single call site the warmups and the
-        dispatcher share, so an argument-shape drift between modes is
-        structurally impossible. ``spec`` defaults to the live
-        speculative state (the warmups pin it explicitly so both
-        program sets compile before serving)."""
+        cache, grammar state + tables, staged ragged admissions) and
+        thread the chained device state back — the single call site
+        the warmups and the dispatcher share, so an argument-shape
+        drift between modes is structurally impossible. ``spec``
+        defaults to the live speculative state (the warmups pin it
+        explicitly so both program sets compile before serving).
+        ``adm_w`` selects the ragged mixed-chunk program for that
+        admission window width; ``adm_args`` is its trailing staged-
+        admission vector tuple."""
         if spec is None:
             spec = self._spec_active()
         args = (self.params, self._tok_d, self._pos_d, self._cache,
@@ -4523,8 +5042,13 @@ class BatchedJaxEngine(JaxEngine):
         if self._grammar is not None:
             tc, ok, nx = self._grammar_tables_d()
             args = args + (self._fsm_d, tc, ok, nx)
-        fns = self._spec_chunk_fns if spec else self._batch_chunk_fns
-        out = fns[bucket](*args)
+        if adm_w is not None:
+            out = self._ragged_chunk_fns[(adm_w, spec)](
+                *(args + adm_args))
+        else:
+            fns = (self._spec_chunk_fns if spec
+                   else self._batch_chunk_fns)
+            out = fns[bucket](*args)
         if spec and self._grammar is not None:
             (packed, self._tok_d, self._pos_d, self._cache,
              self._active_d, self._ngen_d, self._draft_cache,
@@ -4557,6 +5081,49 @@ class BatchedJaxEngine(JaxEngine):
                     "non-speculative decode")
         spec = self._spec_active()
         ct = self._chunk_tokens if spec else self.chunk_len
+        # Ragged staged admissions (ISSUE 19): every pending suffix
+        # window rides THIS chunk — the prologue prefills, samples, and
+        # arms them in the same program dispatch as everyone else's
+        # decode/verify step. The admission width is the smallest
+        # prefill bucket covering the longest staged suffix; a spec
+        # chunk's row widens by the prologue's one token.
+        adm_w: Optional[int] = None
+        adm_args: tuple = ()
+        staged: dict = {}
+        if self._use_ragged and self._pending_adm:
+            staged = {i: e for i, e in self._pending_adm.items()
+                      if self._slots[i] is not None
+                      and not self._slots[i].exhausted}
+            self._pending_adm.clear()
+        if staged:
+            longest = max(len(e["ids"]) for e in staged.values())
+            adm_w = next(b for b in self.prefill_buckets if b >= longest)
+            if spec:
+                ct = self._chunk_tokens + 1
+            N = self.batch_size
+            a_tok = np.zeros((N, adm_w), np.int32)
+            a_len = np.zeros((N,), np.int32)
+            a_start = np.zeros((N,), np.int32)
+            a_ngen0 = np.zeros((N,), np.int32)
+            a_budget = np.zeros((N,), np.int32)
+            a_seed = np.zeros((N,), np.int32)
+            a_temp = np.zeros((N,), np.float32)
+            a_gs = np.zeros((N,), np.int32)
+            for i, e in staged.items():
+                L = len(e["ids"])
+                a_tok[i, :L] = e["ids"]
+                a_len[i] = L
+                a_start[i] = e["start"]
+                a_ngen0[i] = e["ngen0"]
+                a_budget[i] = e["budget"]
+                a_seed[i] = e["seed"]
+                a_temp[i] = e["temp"]
+                a_gs[i] = max(e["gs"], 0)
+            adm_args = tuple(jnp.asarray(x) for x in (
+                a_tok, a_len, a_start, a_ngen0, a_budget, a_seed,
+                a_temp))
+            if self._grammar is not None:
+                adm_args = adm_args + (jnp.asarray(a_gs),)
         active_slots = [s for s in self._slots
                         if s is not None and not s.exhausted]
         if not active_slots:
@@ -4600,8 +5167,15 @@ class BatchedJaxEngine(JaxEngine):
             t0, phase0, bucket0, toks0 = pend
             self._steptime.note(phase0, bucket0, now - t0,
                                 steps=toks0[0], tokens=toks0[1], now=now)
+        # A mixed admission chunk samples into the PREFILL phase keyed
+        # by the ragged admission width — its prologue does real
+        # prefill work, and one fat window must not pollute the decode
+        # digests' anomaly baselines (ISSUE 15).
         self._steptime_pending = (
-            now, PHASE_SPEC_VERIFY if spec else PHASE_DECODE, bucket,
+            now,
+            PHASE_PREFILL if adm_w is not None
+            else PHASE_SPEC_VERIFY if spec else PHASE_DECODE,
+            adm_w if adm_w is not None else bucket,
             (ct, ct * len(active_slots)))
         self._steptime_consumed = False
         # decode:nan fault seam: normally the cached all-False mask; a
@@ -4630,7 +5204,7 @@ class BatchedJaxEngine(JaxEngine):
         packed_d = self._run_chunk(
             bucket, force, corrupt_d,
             self._tables_d(self._tables) if self._use_pool else None,
-            spec=spec)
+            spec=spec, adm_w=adm_w, adm_args=adm_args)
         snapshot = [
             s.req if s is not None and not s.exhausted else None
             for s in self._slots
@@ -4645,6 +5219,7 @@ class BatchedJaxEngine(JaxEngine):
         self._chunk_log.append({
             "t": time.time(), "event": "dispatch", "kv_bucket": bucket,
             "slots": len(active_slots),
+            "admissions": len(staged),
             "pipe": sum(1 for e in self._inflight if e[0] == "chunk"),
         })
 
@@ -4909,10 +5484,15 @@ class BatchedJaxEngine(JaxEngine):
                 # parity. The anchors are exact host truth: the device
                 # carry sits at anchor_pos + tokens-emitted-since-arm,
                 # plus one ct bound per still-in-flight chunk.
+                # Under ragged admission a chunk carrying staged slots
+                # emits up to _chunk_tokens + 1 (the prologue token), so
+                # the per-chunk bound widens by one to stay an upper
+                # bound for every chunk shape.
                 slot.pos = (slot.anchor_pos
                             + (len(slot.detok.ids) - slot.anchor_g)
                             + slot.decode_chunks_inflight
-                            * self._chunk_tokens)
+                            * (self._chunk_tokens
+                               + (1 if self._use_ragged else 0)))
             if slot.req.trace is not None:
                 slot.req.trace.event(
                     f"engine: chunk consumed (+{len(new_ids)} tok"
@@ -4955,6 +5535,9 @@ class BatchedJaxEngine(JaxEngine):
                 wasted_inflight: bool = False) -> None:
         slot = self._slots[slot_idx]
         self._slots[slot_idx] = None
+        # A staged ragged admission finished before its chunk (cancel /
+        # deadline sweeps) must not arm a later occupant of the slot.
+        self._pending_adm.pop(slot_idx, None)
         if slot is None:  # pragma: no cover - defensive
             return
         if self._use_pool:

@@ -249,6 +249,7 @@ class FakeChunkedEngine:
                  kv_pool_blocks: int = 0,
                  radix_cache: bool = True,
                  radix_lru_blocks: int = 0,
+                 ragged_attention: str = "auto",
                  grammar_decode: bool = False,
                  grammar_profile: str = "default",
                  grammar_forced_run_min: int = 4,
@@ -372,6 +373,27 @@ class FakeChunkedEngine:
         self._pool_starved = 0
         if self.kv_pool:
             self._pool_reset()
+        # Ragged paged attention mirror (ISSUE 19): the fake has no
+        # kernels, so this mirrors the SCHEDULER policy only — "on"
+        # defers the admission's first sampled token to the next chunk
+        # (the batcher's staged-admission prologue), so the deferral
+        # bookkeeping (TTFT catch at consume, budget/EOS-at-first edges,
+        # grammar first-pick in-chunk) runs in tier-1. "auto" resolves
+        # off here — the real auto gate is TPU-only.
+        if ragged_attention not in ("auto", "on", "off"):
+            raise ValueError(
+                f"RAGGED_ATTENTION must be auto|on|off, "
+                f"got {ragged_attention!r}")
+        self.ragged_attention = ragged_attention
+        self._use_ragged = (ragged_attention == "on" and self.kv_pool
+                            and self.device_termination)
+        self._attention_regime = ("ragged" if self._use_ragged
+                                  else "paged" if self.kv_pool
+                                  else "dense")
+        # Admission width of staged (deferred-first-token) admissions
+        # since the last dispatch — keys that dispatch's sentinel
+        # sample as a ragged prefill phase (mirror of the batcher).
+        self._pending_adm_w = 0
         # Grammar-constrained decoding mirror (ISSUE 11): the SAME
         # GrammarRuntime/TokenFSM compile the batcher runs, built
         # against the ByteTokenizer the fake's grammar streams use
@@ -584,6 +606,9 @@ class FakeChunkedEngine:
         body["starved_slots_total"] = self._pool_starved
         body["radix"] = (self._radix.stats() if self._radix is not None
                          else None)
+        # ISSUE 19 surface parity: the regime actually serving decode
+        # attention (policy mirror — the fake has no kernels).
+        body["attention_regime"] = self._attention_regime
         return body
 
     # ------------------------------- grammar-constrained decode (ISSUE 11)
@@ -1189,6 +1214,14 @@ class FakeChunkedEngine:
                     run, ends_eos = [], False
             if run:
                 emitted0 = list(run)
+            elif self._use_ragged:
+                # Ragged admission mirror (ISSUE 19): the first SAMPLED
+                # token is NOT picked here — the next chunk's first row
+                # emits stream[0] (through the same in-chunk grammar
+                # pick / EOS / budget folds every decode step runs), so
+                # the slot seats with an empty transcript and TTFT rides
+                # the consume path's first-token catch.
+                emitted0 = []
             else:
                 first = req.stream[0]
                 if grammar_on:
@@ -1218,8 +1251,9 @@ class FakeChunkedEngine:
                              dev_idx=len(emitted0),
                              dev_ngen=len(emitted0),
                              dev_active=req.max_tokens > len(emitted0),
-                             last_tok=emitted0[-1],
-                             t_first=time.monotonic(),
+                             last_tok=emitted0[-1] if emitted0 else 0,
+                             t_first=(time.monotonic() if emitted0
+                                      else None),
                              blocks=blocks, pool_ids=basis,
                              gs=gs0, dev_gs=gs0)
             if req.export is not None and blocks:
@@ -1229,18 +1263,29 @@ class FakeChunkedEngine:
             if not self.device_termination:
                 slot.dev_active = True
             self._slots[i] = slot
-            # Sentinel prefill sample (mirror of the batcher's
-            # admission→first-token measurement; the fake's "prefill"
-            # is host work, μs-scale — the self-calibrated envelope
-            # makes it a meaningful regression signal regardless).
-            self._steptime.note(
-                PHASE_PREFILL, prefill_bucket(len(req.prompt_ids)),
-                time.monotonic() - t_adm0,
-                tokens=len(req.prompt_ids))
+            if self._use_ragged:
+                # Ragged admission: the prefill "program" rides the next
+                # chunk — that dispatch's sentinel sample is a PREFILL
+                # phase keyed by the admission width, not a decode
+                # sample (mirror of the batcher's mixed-chunk keying).
+                self._pending_adm_w = max(
+                    self._pending_adm_w,
+                    prefill_bucket(len(req.prompt_ids)))
+            else:
+                # Sentinel prefill sample (mirror of the batcher's
+                # admission→first-token measurement; the fake's
+                # "prefill" is host work, μs-scale — the self-calibrated
+                # envelope makes it a meaningful regression signal
+                # regardless).
+                self._steptime.note(
+                    PHASE_PREFILL, prefill_bucket(len(req.prompt_ids)),
+                    time.monotonic() - t_adm0,
+                    tokens=len(req.prompt_ids))
             if req.export is not None:
                 req.export.ids = list(slot.emitted)
-            req.out_queue.put_nowait(
-                ("token", self._piece(emitted0, 0)))
+            if emitted0:
+                req.out_queue.put_nowait(
+                    ("token", self._piece(emitted0, 0)))
             if run:
                 self._grammar_forced += len(run)
                 self._grammar_ff_splices += 1
@@ -1292,9 +1337,16 @@ class FakeChunkedEngine:
                                 steps=steps0, tokens=toks0, now=now)
         n_live = sum(s is not None for s in self._slots)
         ct0 = self._chunk_tokens if spec else self.chunk_len
+        # Ragged admission (ISSUE 19): a chunk carrying a staged
+        # admission is a PREFILL-phase sample keyed by the admission
+        # width, so mixed chunks never pollute the decode digests
+        # (mirror of the batcher's keying).
+        adm_w, self._pending_adm_w = self._pending_adm_w, 0
         self._steptime_pending = (
-            now, PHASE_SPEC_VERIFY if spec else PHASE_DECODE,
-            self.batch_size, (ct0, ct0 * n_live))
+            now,
+            PHASE_PREFILL if adm_w else (
+                PHASE_SPEC_VERIFY if spec else PHASE_DECODE),
+            adm_w if adm_w else self.batch_size, (ct0, ct0 * n_live))
         self._steptime_consumed = False
         N = self.batch_size
         C = self._chunk_tokens if spec else self.chunk_len
@@ -1543,6 +1595,12 @@ class FakeChunkedEngine:
                     slot.req.max_tokens)
                 self._bill_waste(wasted, slot.req)
             if new_ids:
+                if slot.t_first is None:
+                    # Ragged admission (ISSUE 19): the first sampled
+                    # token rode this chunk — TTFT lands here.
+                    slot.t_first = time.monotonic()
+                    if slot.req.t_first0 is None:
+                        slot.req.t_first0 = slot.t_first
                 piece = self._piece(new_ids, len(slot.emitted))
                 slot.emitted.extend(new_ids)
                 if slot.req.export is not None:

@@ -147,7 +147,11 @@ def _pool_flat_pos(tables, positions, page: int, n_blocks: int,
     oob = n_blocks * page
     flat = jnp.where(blk >= n_blocks, oob, flat)
     if write_mask is not None:
-        flat = jnp.where(write_mask[:, None], flat, oob)
+        # [B] gates whole rows (device-side termination); [B, S] gates
+        # per token — ragged admission windows (ISSUE 19) write only
+        # their first q_lens[b] columns.
+        wm = write_mask if write_mask.ndim == 2 else write_mask[:, None]
+        flat = jnp.where(wm, flat, oob)
     return flat
 
 
@@ -294,7 +298,8 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
            batch_idx: jnp.ndarray,
            token_mask,
            write_mask=None,
-           block_tables=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+           block_tables=None,
+           q_lens=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One transformer block. Returns (h_out, new_layer_k, new_layer_v).
 
     The ``jax.named_scope`` blocks here (and in ``forward``/sampling) are
@@ -351,7 +356,34 @@ def _layer(cfg: ModelConfig, attn_impl: str, mesh, page_size: int,
         kv_pos = jnp.arange(kv_limit)[None, None, :]
         mask = kv_pos <= positions[:, :, None]
         with jax.named_scope("attention"):
-            if attn_impl == "paged" and S == 1 and not is_q:
+            if attn_impl == "ragged" and not is_q:
+                # ONE kernel for every window shape (ISSUE 19): per-slot
+                # q_len is 1 for decode, k+1 for spec verify, a prompt
+                # span for (suffix) prefill — a mixed chunk is a single
+                # dispatch. The scatter above already wrote the window's
+                # own K/V into the pool, so the kernel reads everything
+                # (context + window) through the block table; causal-in-
+                # window masking gives column j exactly kv <= pos + j,
+                # bitwise the gather path's semantics. int8 KV keeps the
+                # loud gather fallback (is_q branch below) — the engine
+                # resolves that regime at startup.
+                ql = (jnp.full((B,), S, jnp.int32) if q_lens is None
+                      else q_lens.astype(jnp.int32))
+                if mesh is not None and mesh.shape["model"] > 1:
+                    from ..ops.ragged_attention import \
+                        ragged_attention_pool_sharded
+
+                    attn = ragged_attention_pool_sharded(
+                        q, layer_k, layer_v, ql, positions[:, 0],
+                        block_tables, mesh, page_size=page)
+                else:
+                    from ..ops.ragged_attention import \
+                        ragged_attention_pool
+
+                    attn = ragged_attention_pool(
+                        q, layer_k, layer_v, ql, positions[:, 0],
+                        block_tables, page_size=page)
+            elif attn_impl == "paged" and S == 1 and not is_q:
                 # TPU fast path: the block-table pallas kernel reads only
                 # each slot's live pages straight from the pool — no
                 # gathered copy ever materializes. Under a >1 model axis
@@ -585,6 +617,11 @@ def forward(
                                       # >= n_blocks are the unmapped-page
                                       # sentinel (writes drop, reads are
                                       # causally masked)
+    q_lens: Optional[jnp.ndarray] = None,  # [B] int32, attn_impl="ragged"
+                                      # only: valid query columns per slot
+                                      # (1=decode, k+1=spec verify,
+                                      # span=prefill; 0 freezes). None =
+                                      # all S columns valid. ISSUE 19.
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run the model over a token chunk (prefill: S>1; decode: S=1).
 
@@ -654,7 +691,7 @@ def forward(
             lp, layer_k, layer_v = xs
             h, new_k, new_v = step(h, lp, layer_k, layer_v, positions, kv_limit,
                                    batch_idx, token_mask, write_mask,
-                                   block_tables)
+                                   block_tables, q_lens)
             return h, (new_k, new_v)
 
         h, (new_k, new_v) = jax.lax.scan(

@@ -1,0 +1,271 @@
+"""Pallas ragged paged attention (ISSUE 19; PAPERS.md ragged paged
+attention — exactly this kernel, on TPU).
+
+ONE kernel for every attention shape the serving loop runs over the
+block pool: per-slot QUERY length ``q_lens[n]`` is 1 for a decode step,
+k+1 for a speculative verify window, and a prompt-span for (suffix)
+prefill — so a mixed chunk (fresh admissions + decoding slots + spec
+verify) is a single program dispatch instead of three compiled worlds
+(the single-query paged kernel, the ``(bucket, kv_limit)`` dense
+prefill ladder, and the dense gather fallback).
+
+Shape contract:
+
+- ``q``            [N, W, H, hd] — per-slot query windows padded to W;
+  slot n's valid queries are columns ``0 .. q_lens[n]-1``, the first at
+  absolute position ``positions[n]`` (so column j sits at
+  ``positions[n] + j``).
+- ``k``/``v``      [n_blocks, page, KV, hd] — the shared block pool.
+- ``q_lens``       [N] int32 — 0 freezes a slot (output rows are zeros,
+  compute masked); 1 = decode; k+1 = verify; span = prefill.
+- ``positions``    [N] int32 — absolute position of query column 0.
+- ``block_tables`` [N, max_pages] int32 — pool block per sequence page;
+  entries >= n_blocks are the unmapped-page sentinel.
+
+Same TPU-first design as ops/paged_attention.py (this kernel is that
+one generalized from W=1): grid ``(slot, page)`` with positions +
+query lengths + tables scalar-prefetched, dead pages clamped to the
+slot's LAST LIVE page in the BlockSpec index map (repeat block indices
+elide the HBM→VMEM fetch, ``pl.when`` elides the compute), online
+softmax state persisted in VMEM scratch across the sequential page
+dimension. Causal-in-window masking: query column j attends kv
+positions ``<= positions[n] + j`` — bitwise the same semantics as the
+dense gather path (models/transformer.py::_pool_gather +
+dense_attention with the decode causal mask), which stays as the loud
+fallback for int8 KV and head counts that don't divide tp.
+
+Interpret mode runs the same kernel on CPU for tests and CI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def ragged_supported(page_size: int, head_dim: int,
+                     n_pages: int) -> bool:
+    """Compiled-kernel constraints — same lane/sublane tiling rules as
+    the single-query paged kernel (ops/paged_attention.py)."""
+    return head_dim % 128 == 0 and page_size >= 8 and n_pages >= 1
+
+
+def _ragged_pool_kernel(pos_ref, qlen_ref, tbl_ref, q_ref, k_ref, v_ref,
+                        o_ref, m_scr, l_scr, acc_scr, *, page_size: int,
+                        scale: float, n_pages: int, kv_heads: int,
+                        w: int):
+    """Online-softmax body over one (slot, page) grid cell, W query rows
+    at a time. Rows are laid out [KV, W*G] (row r is query column
+    ``r // G`` of KV group ``r % G``'s block) so one KV-batched
+    ``dot_general`` serves every query column and head of the block —
+    the same working-set shape as the W=1 paged kernel, widened."""
+    del tbl_ref                       # consumed by the index map
+    n = pl.program_id(0)
+    p = pl.program_id(1)
+    pos = pos_ref[n]
+    q_len = qlen_ref[n]
+    last_page = (pos + jnp.maximum(q_len, 1) - 1) // page_size
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(p <= last_page)
+    def _accumulate():
+        H, hd = q_ref.shape[2], q_ref.shape[3]
+        G = H // kv_heads
+        # [W, H, hd] -> [KV, W*G, hd]: head h of column j lands at row
+        # j*G + h%G of KV group h//G — query column recoverable as
+        # row // G for the causal mask below.
+        qg = jnp.swapaxes(
+            q_ref[0].reshape(w, kv_heads, G, hd), 0, 1
+        ).reshape(kv_heads, w * G, hd)
+        k = jnp.swapaxes(k_ref[0], 0, 1)                # [KV, page, hd]
+        v = jnp.swapaxes(v_ref[0], 0, 1)
+        s = jax.lax.dot_general(
+            qg, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # [KV, W*G, page]
+        kv_ids = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2
+        )
+        q_ids = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) // G
+        # Causal-in-window: column j attends kv <= pos + j; padded
+        # columns (j >= q_len) mask everything — their normalizer stays
+        # 0 and the finalize writes zeros (outputs are never read).
+        mask = jnp.logical_and(kv_ids <= pos + q_ids, q_ids < q_len)
+        s = jnp.where(mask, s, -jnp.inf)
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        pexp = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.where(m_prev == -jnp.inf, 0.0,
+                          jnp.exp(m_prev - m_new))
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + jnp.sum(pexp, axis=2,
+                                              keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                               # [KV, W*G, hd]
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        H, hd = o_ref.shape[2], o_ref.shape[3]
+        G = H // kv_heads
+        l = l_scr[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        out = (acc_scr[...] / l).reshape(kv_heads, w, G, hd)
+        o_ref[0] = jnp.swapaxes(out, 0, 1).reshape(
+            w, H, hd).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "scale", "interpret"),
+)
+def ragged_attention_pool(
+    q: jnp.ndarray,             # [N, W, H, hd] per-slot query windows
+    k: jnp.ndarray,             # [n_blocks, page, KV, hd] shared pool
+    v: jnp.ndarray,             # [n_blocks, page, KV, hd]
+    q_lens: jnp.ndarray,        # [N] int32 valid queries per slot
+    positions: jnp.ndarray,     # [N] int32 abs position of column 0
+    block_tables: jnp.ndarray,  # [N, max_pages] int32
+    *,
+    page_size: int = 128,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Ragged block-paged attention over the pool. Returns
+    [N, W, H, hd]; rows past ``q_lens[n]`` are zeros (never read —
+    ``logits_at`` gathers the last valid column).
+
+    Cost per slot tracks ``ceil((positions[n]+q_lens[n])/page)`` live
+    pages, whatever mixture of decode / verify / prefill widths the
+    batch carries — the mixed-chunk property ISSUE 19 is about."""
+    if pltpu is None:
+        raise NotImplementedError(
+            "ragged_attention_pool requires jax.experimental.pallas.tpu; "
+            "use the dense gather path"
+        )
+    N, W, H, hd = q.shape
+    n_blocks, page, KV, _ = k.shape
+    if page != page_size:
+        raise ValueError(f"pool page {page} != page_size {page_size}")
+    n_pages = block_tables.shape[1]
+    if scale is None:
+        scale = hd ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    G = H // KV
+    pos = positions.astype(jnp.int32)
+    qln = q_lens.astype(jnp.int32)
+    tbl = jnp.clip(block_tables.astype(jnp.int32), 0, n_blocks - 1)
+
+    kernel = functools.partial(
+        _ragged_pool_kernel, page_size=page_size, scale=scale,
+        n_pages=n_pages, kv_heads=KV, w=W,
+    )
+
+    def q_map(n, p, pos_ref, qlen_ref, tbl_ref):
+        return (n, 0, 0, 0)
+
+    def kv_map(n, p, pos_ref, qlen_ref, tbl_ref):
+        # Clamp dead pages to the slot's LAST LIVE page (which covers
+        # the window's own freshly-written rows: pos + q_len - 1), then
+        # indirect through the table — repeat block indices elide the
+        # fetch, pl.when elides the compute.
+        last = (pos_ref[n]
+                + jnp.maximum(qlen_ref[n], 1) - 1) // page_size
+        pp = jnp.minimum(p, last)
+        return (tbl_ref[n, pp], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(N, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, W, H, hd), q_map),
+            pl.BlockSpec((1, page_size, KV, hd), kv_map),
+            pl.BlockSpec((1, page_size, KV, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, W, H, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((KV, W * G, 1), jnp.float32),
+            pltpu.VMEM((KV, W * G, 1), jnp.float32),
+            pltpu.VMEM((KV, W * G, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, W, H, hd), q.dtype),
+        interpret=interpret,
+    )(pos, qln, tbl, q, k, v)
+    return out
+
+
+def ragged_attention_pool_sharded(
+    q: jnp.ndarray,             # [N, W, H, hd]
+    k: jnp.ndarray,             # [n_blocks, page, KV, hd]
+    v: jnp.ndarray,
+    q_lens: jnp.ndarray,        # [N]
+    positions: jnp.ndarray,     # [N]
+    block_tables: jnp.ndarray,  # [N, max_pages]
+    mesh,
+    *,
+    page_size: int = 128,
+) -> jnp.ndarray:
+    """Mesh-aware ragged kernel dispatch, mirroring
+    ``paged_decode_attention_pool_sharded`` (ISSUE 14): XLA can't
+    auto-partition a ``pallas_call``, so under a >1 ``model`` axis the
+    kernel runs shard_mapped with Q and KV heads split together over
+    ``model`` — the pool shards on the KV-head axis
+    (parallel/sharding.py::pool_cache_specs), so each shard holds whole
+    KV groups and the local G = H_local/KV_local stays the true
+    grouping. Positions, query lengths and tables are replicated
+    (per-slot host truth). Head counts that don't divide the axis serve
+    the LOUD gather fallback instead — engine startup resolves that."""
+    tp = mesh.shape["model"] if mesh is not None else 1
+    H, KV = q.shape[2], k.shape[2]
+    if tp <= 1:
+        return ragged_attention_pool(q, k, v, q_lens, positions,
+                                     block_tables, page_size=page_size)
+    if KV % tp or H % tp:
+        raise ValueError(
+            f"ragged pool kernel needs KV ({KV}) and H ({H}) divisible "
+            f"by the model axis ({tp}); engine startup resolves such "
+            f"meshes to the gather path")
+    import jax.sharding as jsh
+
+    from ..parallel.compat import shard_map
+
+    P_ = jsh.PartitionSpec
+
+    def _local(ql, kl, vl, qlen, pos, tbl):
+        return ragged_attention_pool(ql, kl, vl, qlen, pos, tbl,
+                                     page_size=page_size)
+
+    return shard_map(
+        _local, mesh=mesh,
+        in_specs=(P_(None, None, "model", None),
+                  P_(None, None, "model", None),
+                  P_(None, None, "model", None),
+                  P_(None), P_(None), P_(None, None)),
+        out_specs=P_(None, None, "model", None),
+        axis_names=set(mesh.axis_names),
+        # pallas_call can't express per-axis varying metadata for the
+        # VMA checker; the specs above are the contract (same rule as
+        # the paged kernel's shard_map).
+        check_vma=False,
+    )(q, k, v, q_lens, positions, block_tables)

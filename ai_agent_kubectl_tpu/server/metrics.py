@@ -237,6 +237,20 @@ class Metrics:
             "fallback) — a silent gather must be visible",
             registry=r,
         )
+        # Ragged paged attention (ISSUE 19): which regime actually
+        # serves decode attention — enum-style gauge (1 on the active
+        # label) so a fallback from ragged (int8 KV, non-dividing tp,
+        # CPU auto-off) is a dashboard fact, not an inference.
+        self.decode_attention_regime = Gauge(
+            "decode_attention_regime",
+            "1 for the attention regime actually serving decode "
+            "(ragged = one kernel for prefill/decode/spec-verify over "
+            "the block pool; paged = single-query paged kernel; "
+            "gather = dense gather over pool pages; dense = per-slot "
+            "dense KV ladder)",
+            ["regime"],
+            registry=r,
+        )
 
         # Decode-pipeline metrics (ISSUE 4: device-side termination +
         # deep chunk pipelining). Occupancy/config are gauges sampled at
@@ -666,6 +680,10 @@ class Metrics:
         pipeline/containment mirrors."""
         for state in ("free", "live", "cached"):
             self.kv_pool_blocks.labels(state=state).set(pool.get(state, 0))
+        # ISSUE 19: single-chip engines surface the attention regime on
+        # the pool body (sharding_health is None without a mesh) — the
+        # mesh path sets the same gauge from observe_sharding.
+        self._set_attention_regime(pool.get("attention_regime"))
         seen = self._kv_pool_seen
         radix = pool.get("radix") or {}
         for key, counter, total in (
@@ -693,6 +711,14 @@ class Metrics:
             1 if sharding.get("draft_sharded") else 0)
         self.spec_draft_kv_fallback.set(
             1 if sharding.get("draft_kv_fallback") else 0)
+        self._set_attention_regime(sharding.get("attention_regime"))
+
+    def _set_attention_regime(self, active) -> None:
+        if not active:
+            return
+        for regime in ("ragged", "paged", "gather", "dense"):
+            self.decode_attention_regime.labels(regime=regime).set(
+                1 if regime == active else 0)
 
     def observe_containment(self, stats: dict) -> None:
         """Delta-mirror the engine supervisor's containment totals

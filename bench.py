@@ -925,6 +925,102 @@ async def phase_paged7b(batch_size: int, max_seq: int, kv_quant: str,
     return out
 
 
+async def phase_ragged7b(batch_size: int, max_seq: int, kv_quant: str,
+                         ragged: bool, spec_k: int = 4,
+                         chunk_len: int = 16) -> dict:
+    """One rung of the ISSUE 19 ragged-kernel sweep: a MIXED workload —
+    staggered admissions arriving while earlier requests decode, spec
+    verify riding the same chunks — served by the single ragged paged
+    kernel vs the legacy (bucket, kv_limit) program ladder. The
+    artifact carries tok/s AND the compiled-program count (chunk +
+    prefill + ragged sets): the perf claim is one kernel serving
+    prefill, decode, and verify from one program set, so the count
+    must drop alongside the throughput story. The workload staggers
+    three admission waves (full bs, then bs/2 twice, offset by a
+    quarter of the decode span) so ragged rungs actually exercise
+    mixed prefill+decode+verify chunks rather than one clean burst."""
+    import jax
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "not on TPU"}
+
+    cfg7 = get_config("gemma-7b-it")
+    tok7, _ = make_tokenizer(cfg7)
+    log(f"bench: ragged7b rung bs={batch_size} "
+        f"ragged={'on' if ragged else 'off'} k={spec_k}")
+    eng = BatchedJaxEngine(
+        cfg7,
+        tokenizer=tok7,
+        dtype="bfloat16",
+        quant="int8",
+        kv_quant=kv_quant,
+        max_seq_len=max_seq,
+        prefill_buckets=(64, 128),
+        batch_size=batch_size,
+        chunk_len=chunk_len,
+        kv_pool=True,
+        ragged_attention="on" if ragged else "off",
+        model_path=os.environ.get("MODEL_PATH") or None,
+        spec_decode=True,
+        spec_draft_k=spec_k,
+        spec_draft_model="gemma-2b-it",
+        spec_draft_path=os.environ.get("SPEC_DRAFT_PATH") or None,
+    )
+    t0 = time.monotonic()
+    await eng.start()
+    log(f"bench: ragged7b engine ready in {time.monotonic() - t0:.1f}s")
+    programs = (len(getattr(eng, "_batch_chunk_fns", {}) or {})
+                + len(getattr(eng, "_spec_chunk_fns", {}) or {})
+                + len(getattr(eng, "_ragged_chunk_fns", {}) or {})
+                + len(getattr(eng, "_pool_prefill_fns", {}) or {}))
+    queries = [render_prompt(q) for q in GRAMMAR_QUERIES]
+
+    async def wave(n: int, delay: float, tag: int) -> list:
+        await asyncio.sleep(delay)
+        return await asyncio.gather(*[
+            eng.generate(queries[(tag + i) % len(queries)],
+                         max_tokens=48, temperature=0.0)
+            for i in range(n)])
+
+    n_tokens = 0
+    t0 = time.monotonic()
+    for _ in range(2):
+        # Staggered waves: the half-size waves land mid-decode, so the
+        # ragged rung's admissions ride chunks that are also decoding
+        # and verifying — the mixed-chunk case the kernel exists for.
+        waves = await asyncio.gather(
+            wave(batch_size, 0.0, 0),
+            wave(batch_size // 2, 0.4, 1),
+            wave(batch_size // 2, 0.8, 2))
+        n_tokens += sum(r.completion_tokens
+                        for w in waves for r in w)
+    wall = time.monotonic() - t0
+    stats = eng.stats()
+    pool_stats = stats.get("kv_pool") or {}
+    sh = eng.spec_health() or {}
+    steptime = _steptime_summary(eng)
+    await eng.stop()
+    return {
+        "model": "gemma-7b-it",
+        "batch_size": batch_size,
+        "max_seq_len": max_seq,
+        "kv_quant": kv_quant,
+        "ragged": ragged,
+        "spec_k": spec_k,
+        "attention_regime": pool_stats.get("attention_regime"),
+        "compiled_programs": programs,
+        "completion_tokens": n_tokens,
+        "acceptance_ratio": sh.get("acceptance_ratio"),
+        "step_time": steptime,
+        "tokens_per_sec_per_chip": round(
+            n_tokens / wall / len(jax.devices()), 2),
+    }
+
+
 def phase_attr7b(batch_size: int, max_seq: int, kv_quant: str) -> dict:
     """Decode-step cost attribution for the 7B geometry that just served
     (VERDICT r5 weak #1): the engine-identical donated chunk under
@@ -1320,6 +1416,40 @@ def orchestrate() -> dict:
         if spec_sweep:
             extra7["spec_sweep"] = spec_sweep
 
+        # Ragged-kernel sweep (ISSUE 19): the mixed workload (staggered
+        # admissions + spec verify in the same chunks) under the single
+        # ragged paged kernel vs the legacy program ladder, at bs 48 and
+        # 192 (the pool geometry the kernel is supposed to carry).
+        # Keyed per (bs, mode) like tp_spec_sweep so the perf gate's
+        # dict walk reaches each rung's tok/s and program count; a
+        # failed rung rides its key as an explicit {"status": ...}.
+        ragged_sweep: dict = {}
+        ragged_keys = ("tokens_per_sec_per_chip", "compiled_programs",
+                       "attention_regime", "acceptance_ratio",
+                       "completion_tokens", "step_time")
+        for bs in (48, 192):
+            for mode in ("ragged", "ladder"):
+                rr = _run_phase(
+                    ["--phase", "ragged7b", "--bs", str(bs),
+                     "--max-seq", str(extra7["max_seq_len"]),
+                     "--kv-quant", extra7["kv_quant"],
+                     "--ragged", "on" if mode == "ragged" else "off"],
+                    timeout=1800)
+                if isinstance(rr, dict) and "skipped" in rr:
+                    log(f"bench: ragged7b bs={bs} {mode} skipped "
+                        f"({rr['skipped']})")
+                    continue
+                key = f"bs{bs}_{mode}"
+                if _ok(rr):
+                    ragged_sweep[key] = {k: rr.get(k)
+                                         for k in ragged_keys}
+                elif isinstance(rr, dict) and "status" in rr:
+                    ragged_sweep[key] = rr
+                    log(f"bench: ragged7b bs={bs} {mode} failed; "
+                        "continuing")
+        if ragged_sweep:
+            extra7["ragged_sweep"] = ragged_sweep
+
         # TP sweep (ISSUE 14): the MEASURED sharded step at bs 48/96/192
         # on the 8-virtual-device CPU mesh (a single-chip bench host has
         # no 8-way ICI; the virtual mesh measures the real programs —
@@ -1416,7 +1546,7 @@ def main() -> None:
     ap.add_argument("--phase", choices=["7b", "2b", "moe", "attr7b",
                                         "pipe7b", "paged7b",
                                         "grammar7b", "spec7b", "tp7b",
-                                        "tp_spec7b"],
+                                        "tp_spec7b", "ragged7b"],
                     default=None)
     ap.add_argument("--bs", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=256)
@@ -1431,6 +1561,7 @@ def main() -> None:
     ap.add_argument("--spec-k", type=int, default=4)
     ap.add_argument("--mesh", default="tp=8")
     ap.add_argument("--model", default="gemma-7b-it")
+    ap.add_argument("--ragged", choices=["on", "off"], default="on")
     ns = ap.parse_args()
 
     if ns.phase == "7b":
@@ -1462,6 +1593,10 @@ def main() -> None:
         result = asyncio.run(
             phase_tp_spec7b(ns.bs, ns.max_seq, ns.mesh, ns.model,
                             ns.spec_k, ns.chunk_len))
+    elif ns.phase == "ragged7b":
+        result = asyncio.run(
+            phase_ragged7b(ns.bs, ns.max_seq, ns.kv_quant,
+                           ns.ragged == "on", ns.spec_k, ns.chunk_len))
     elif ns.phase == "attr7b":
         result = phase_attr7b(ns.bs, ns.max_seq, ns.kv_quant)
     elif ns.phase == "2b":

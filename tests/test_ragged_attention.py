@@ -1,0 +1,474 @@
+"""One ragged paged-attention kernel for prefill, decode, and spec
+verify (ISSUE 19).
+
+The acceptance spine: ``ops/ragged_attention.py`` is the ONE program
+the serving loop dispatches over the block pool — per-slot query length
+1 = decode, k+1 = spec verify, prompt-span = (suffix) prefill — and
+NOTHING about the transcript may show it. Ragged-on equals the legacy
+program ladder byte-for-byte at temp 0 AND seeded 0.9, spec k∈{2,4},
+single chip and under the tp mesh (tp=2 shards the kernel, tp=8 serves
+the LOUD gather fallback — still byte-identical). Around it: the
+interpret-mode kernel vs a dense gather reference at mixed query
+lengths over shared and dead-clamped block tables, the mixed
+admission+decode chunk landing as ONE dispatch with the pool books
+balanced, the compiled-program ledger collapsing strictly below the
+``(bucket, kv_limit)`` ladder and surviving containment reset + warm
+weight swap without a re-trace (the PR 13 id()/_cache_size()
+technique), the ``attention_regime`` health/gauge field, and
+RAGGED_ATTENTION config validation.
+
+The engine-building tests are slow-marked (each compiles a program set
+on the CPU backend); the CI "Ragged-kernel parity smoke" step runs
+this file with NO marker filter, so every one still gates every run.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ai_agent_kubectl_tpu.engine.fake import FakeChunkedEngine
+from ai_agent_kubectl_tpu.engine.protocol import RequestQuarantined
+from ai_agent_kubectl_tpu.ops.ragged_attention import (
+    ragged_attention_pool, ragged_attention_pool_sharded, ragged_supported)
+from ai_agent_kubectl_tpu.testing.faults import FaultInjector
+
+PROMPTS = ["list pods", "get nodes -o wide", "describe deployment web"]
+TEMPS = [0.0, 0.9, 0.9]
+SEEDS = [7, 123, 5]
+
+
+# ---------------------------------------------------------------- helpers
+
+def _mk(**kw):
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.engine.tokenizer import ByteTokenizer
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    defaults = dict(dtype="float32", max_seq_len=192,
+                    prefill_buckets=(32, 64), prefix_cache=False,
+                    compile_cache_dir="", batch_size=4, chunk_len=4)
+    defaults.update(kw)
+    return BatchedJaxEngine(get_config("toy-8m"), tokenizer=ByteTokenizer(),
+                            **defaults)
+
+
+def _mk_ragged(**kw):
+    return _mk(ragged_attention="on", **kw)
+
+
+def _books(eng) -> None:
+    holders: dict = {}
+    for slot in list(eng._slots) + list(eng._parked):
+        if slot is not None and slot.blocks:
+            for b in slot.blocks:
+                holders[b] = holders.get(b, 0) + 1
+    if eng._radix is not None:
+        for b, n in eng._radix._held.items():
+            holders[b] = holders.get(b, 0) + n
+    eng._pool.check(holders)
+
+
+async def _serve(eng) -> list:
+    outs = await asyncio.gather(*[
+        eng.generate(p, max_tokens=16, temperature=t, seed=s)
+        for p, t, s in zip(PROMPTS, TEMPS, SEEDS)
+    ])
+    return [r.text for r in outs]
+
+
+def _program_total(eng) -> int:
+    """Every compiled attention-bearing program the engine owns — the
+    ledger bench.py --phase ragged7b records as ``compiled_programs``."""
+    return (len(eng._batch_chunk_fns) + len(eng._spec_chunk_fns)
+            + len(eng._ragged_chunk_fns) + len(eng._pool_prefill_fns))
+
+
+# ----------------------------------------------- kernel units (tier-1)
+#
+# Interpret mode runs the SAME Pallas program the TPU compiles, so the
+# reference comparison here is the semantic ground truth for every
+# engine-level byte-identity test below.
+
+def _reference(q, k, v, q_lens, positions, tables, page):
+    """Dense gather reference: per slot, gather kv rows 0..pos+q_len-1
+    through the block table, softmax per (query column, head) with the
+    causal-in-window rule (column j attends kv <= pos+j)."""
+    N, W, H, hd = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    out = np.zeros((N, W, H, hd), np.float32)
+    scale = hd ** -0.5
+    for n in range(N):
+        qn = int(q_lens[n])
+        if qn == 0:
+            continue
+        pos = int(positions[n])
+        total = pos + qn
+        ks = np.stack([k[tables[n, t // page], t % page]
+                       for t in range(total)])      # [total, KV, hd]
+        vs = np.stack([v[tables[n, t // page], t % page]
+                       for t in range(total)])
+        for j in range(qn):
+            kj = pos + j + 1
+            for h in range(H):
+                g = h // G
+                s = (ks[:kj, g] @ q[n, j, h]) * scale
+                s = s - s.max()
+                w = np.exp(s)
+                w /= w.sum()
+                out[n, j, h] = w @ vs[:kj, g]
+    return out
+
+
+def _mixed_case():
+    """Four slots exercising every query shape the serving loop emits,
+    over a pool with a SHARED prefix page (block 7), the unmapped-page
+    sentinel (99 >= n_blocks), and a NaN-poisoned dead block that must
+    never leak into any output."""
+    rng = np.random.default_rng(0)
+    page, n_blocks, KV, H, hd, W = 8, 12, 2, 4, 16, 8
+    k = rng.standard_normal((n_blocks, page, KV, hd)).astype(np.float32)
+    v = rng.standard_normal((n_blocks, page, KV, hd)).astype(np.float32)
+    k[11] = np.nan          # dead block: nothing live maps it
+    v[11] = np.nan
+    q = rng.standard_normal((4, W, H, hd)).astype(np.float32)
+    #        decode  verify(k+1=5)  prefill-span  frozen
+    q_lens = np.array([1, 5, 8, 0], np.int32)
+    positions = np.array([19, 11, 0, 19], np.int32)
+    tables = np.array([
+        [7, 2, 9, 99],      # 20 live tokens -> pages 0..2
+        [7, 5, 99, 99],     # shares page-0 block 7 with slot 0
+        [0, 99, 99, 99],    # fresh prompt, page 0 only
+        [7, 2, 9, 99],      # frozen slot still holds its pages
+    ], np.int32)
+    return q, k, v, q_lens, positions, tables, page
+
+
+def test_ragged_kernel_matches_gather_reference_mixed_q_lens():
+    """THE kernel unit: one call carrying decode + verify + prefill +
+    frozen rows matches the dense gather reference, dead/sentinel pages
+    clamp (the NaN block never leaks), and q_len=0 rows are zeros."""
+    q, k, v, q_lens, positions, tables, page = _mixed_case()
+    out = np.asarray(ragged_attention_pool(
+        q, k, v, q_lens, positions, tables, page_size=page))
+    assert not np.isnan(out).any(), "dead/NaN pages leaked into outputs"
+    ref = _reference(q, k, v, q_lens, positions, tables, page)
+    for n, qn in enumerate(q_lens):
+        np.testing.assert_allclose(out[n, :qn], ref[n, :qn],
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"slot {n} (q_len={qn})")
+    assert np.all(out[3] == 0.0), "frozen slot rows must be zeros"
+    # Padded columns past q_len are zeros too (never read, still pinned).
+    assert np.all(out[0, 1:] == 0.0)
+
+
+def test_ragged_kernel_decode_column_equals_own_window():
+    """Window invariance: the LAST column of a 5-wide verify window over
+    positions p..p+4 equals a 1-wide decode call at position p+4 — the
+    property that lets spec verify and decode share one program."""
+    q, k, v, _q_lens, _pos, tables, page = _mixed_case()
+    wide = np.asarray(ragged_attention_pool(
+        q, k, v, np.array([5, 5, 5, 5], np.int32),
+        np.array([11, 11, 11, 11], np.int32), tables, page_size=page))
+    narrow_q = np.zeros_like(q)
+    narrow_q[:, 0] = q[:, 4]
+    narrow = np.asarray(ragged_attention_pool(
+        narrow_q, k, v, np.array([1, 1, 1, 1], np.int32),
+        np.array([15, 15, 15, 15], np.int32), tables, page_size=page))
+    np.testing.assert_allclose(wide[:, 4], narrow[:, 0],
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_kernel_sharded_parity_and_head_divisibility():
+    """tp=2 divides KV=2/H=4: the shard_mapped kernel is bitwise the
+    single-device call. tp=8 does not: a LOUD ValueError (engine
+    startup resolves such meshes to the gather path before ever
+    reaching the kernel)."""
+    import jax
+    from jax.sharding import Mesh
+
+    q, k, v, q_lens, positions, tables, page = _mixed_case()
+    base = np.asarray(ragged_attention_pool(
+        q, k, v, q_lens, positions, tables, page_size=page))
+    devs = np.array(jax.devices()[:2]).reshape(1, 2)
+    mesh2 = Mesh(devs, ("data", "model"))
+    sharded = np.asarray(ragged_attention_pool_sharded(
+        q, k, v, q_lens, positions, tables, mesh2, page_size=page))
+    np.testing.assert_allclose(sharded, base, atol=2e-5, rtol=2e-5)
+
+    devs8 = np.array(jax.devices()[:8]).reshape(1, 8)
+    mesh8 = Mesh(devs8, ("data", "model"))
+    with pytest.raises(ValueError, match="divisible by the model axis"):
+        ragged_attention_pool_sharded(q, k, v, q_lens, positions,
+                                      tables, mesh8, page_size=page)
+
+
+def test_ragged_supported_gate():
+    """Compiled-kernel tiling constraints (interpret mode skips them —
+    the CPU tests above run hd=16 on purpose)."""
+    assert ragged_supported(page_size=128, head_dim=256, n_pages=4)
+    assert ragged_supported(page_size=8, head_dim=128, n_pages=1)
+    assert not ragged_supported(page_size=128, head_dim=64, n_pages=4)
+    assert not ragged_supported(page_size=4, head_dim=128, n_pages=4)
+    assert not ragged_supported(page_size=128, head_dim=128, n_pages=0)
+
+
+# ------------------------------------------------- config + fake (tier-1)
+
+def test_config_validates_ragged_knob():
+    from ai_agent_kubectl_tpu.config import ServiceConfig
+
+    with pytest.raises(ValueError, match="RAGGED_ATTENTION"):
+        ServiceConfig(ragged_attention="sometimes")
+    with pytest.raises(ValueError, match="requires KV_POOL"):
+        ServiceConfig(ragged_attention="on", kv_pool=False)
+    assert ServiceConfig(ragged_attention="on").ragged_attention == "on"
+    assert ServiceConfig().ragged_attention == "auto"   # env default
+
+    with pytest.raises(ValueError, match="RAGGED_ATTENTION"):
+        FakeChunkedEngine(ragged_attention="bogus")
+
+
+async def test_fake_ragged_parity_and_regime():
+    """The fake mirror: ragged-on transcripts equal ragged-off byte for
+    byte (the admission restructure, not the kernel, is what the fake
+    models) and the attention_regime field tracks the mode."""
+    on = FakeChunkedEngine(batch_size=4, chunk_len=4,
+                           ragged_attention="on")
+    off = FakeChunkedEngine(batch_size=4, chunk_len=4,
+                            ragged_attention="off")
+    await on.start()
+    await off.start()
+    try:
+        assert on._use_ragged and not off._use_ragged
+        assert on.kv_pool_health()["attention_regime"] == "ragged"
+        assert off.kv_pool_health()["attention_regime"] == "paged"
+        dense = FakeChunkedEngine(batch_size=4, chunk_len=4,
+                                  kv_pool=False)
+        assert dense._attention_regime == "dense"
+        for prompt, temp, seed in zip(PROMPTS, TEMPS, SEEDS):
+            a = await on.generate(prompt, max_tokens=12,
+                                  temperature=temp, seed=seed)
+            b = await off.generate(prompt, max_tokens=12,
+                                   temperature=temp, seed=seed)
+            assert a.text == b.text, (prompt, temp)
+    finally:
+        await on.stop()
+        await off.stop()
+
+
+# --------------------------------------------- jax engine (CI step; slow)
+
+@pytest.mark.slow
+async def test_jax_ragged_vs_ladder_byte_identity_one_dispatch():
+    """THE acceptance test: ragged-on vs the legacy program ladder on
+    identical concurrent traffic — byte-identical at temp 0 and seeded
+    0.9, the mixed admission+decode chunk lands as ONE dispatch (a
+    chunk-log entry carries admissions>0 AND already-decoding slots),
+    health/regime fields report, and the pool books balance after."""
+    ragged = _mk_ragged()
+    ladder = _mk(ragged_attention="off")
+    await ragged.start()
+    ladder.tokenizer = ragged.tokenizer
+    await ladder.start()
+    try:
+        assert ragged._use_ragged and not ladder._use_ragged
+        # Single-chip deployments read the regime from kv_pool_health
+        # (sharding_health is None without a mesh).
+        assert ragged.kv_pool_health()["attention_regime"] == "ragged"
+        assert ladder.kv_pool_health()["attention_regime"] in (
+            "paged", "gather")
+        # Stagger a second wave so admissions stage into chunks that
+        # already carry decoding slots.
+        async def wave(eng):
+            first = asyncio.gather(*[
+                eng.generate(p, max_tokens=16, temperature=t, seed=s)
+                for p, t, s in zip(PROMPTS, TEMPS, SEEDS)])
+            await asyncio.sleep(0.05)
+            second = eng.generate("rollout status web", max_tokens=16,
+                                  temperature=0.9, seed=99)
+            r1, r2 = await asyncio.gather(first, second)
+            return [r.text for r in r1] + [r2.text]
+
+        got = await wave(ragged)
+        want = await wave(ladder)
+        assert got == want
+        mixed = [e for e in ragged._chunk_log
+                 if e.get("event") == "dispatch"
+                 and e.get("admissions", 0) > 0 and e.get("slots", 0) > 1]
+        assert mixed, "no chunk carried admissions alongside decoders"
+        _books(ragged)
+        _books(ladder)
+    finally:
+        await asyncio.gather(ragged.stop(), ladder.stop())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("k", [2, 4])
+async def test_jax_ragged_spec_byte_identity(k):
+    """Spec verify rides the ragged chunk: spec-on under ragged equals
+    spec-off under ragged byte-for-byte (identical-draft => every token
+    accepted => the verify window is pure pipelining), and the spec
+    ragged programs exist as their own (width, spec=True) keys."""
+    plain = _mk_ragged()
+    spec = _mk_ragged(spec_decode=True, spec_draft_k=k,
+                      spec_draft_model="toy-8m", spec_draft_seed=1234)
+    await plain.start()
+    spec.tokenizer = plain.tokenizer
+    await spec.start()
+    try:
+        assert spec._use_spec and spec._use_ragged
+        assert any(s for (_w, s) in spec._ragged_chunk_fns)
+        ref = await _serve(plain)
+        got = await _serve(spec)
+        assert got == ref, f"spec k={k} diverged under ragged"
+        _books(spec)
+    finally:
+        await asyncio.gather(plain.stop(), spec.stop())
+
+
+@pytest.mark.slow
+async def test_jax_ragged_tp_parity_and_gather_fallback():
+    """tp=2 shards the ragged kernel (toy KV=2/H=4 divide), tp=8 can't
+    — the engine resolves to the LOUD gather fallback — and neither may
+    change a byte of the transcript vs single-chip ragged."""
+    single = _mk_ragged()
+    await single.start()
+    engines = [single]
+    try:
+        ref = await _serve(single)
+        for mesh, want_regime in (("tp=2", "ragged"), ("tp=8", "gather")):
+            eng = _mk_ragged(mesh_shape=mesh)
+            eng.tokenizer = single.tokenizer
+            await eng.start()
+            engines.append(eng)
+            assert eng.sharding_health()["attention_regime"] \
+                == want_regime, mesh
+            assert eng._use_ragged is (want_regime == "ragged")
+            got = await _serve(eng)
+            assert got == ref, (mesh, want_regime)
+            _books(eng)
+    finally:
+        await asyncio.gather(*[e.stop() for e in engines])
+
+
+@pytest.mark.slow
+async def test_jax_ragged_program_collapse_and_warm_swap():
+    """The perf clause: ragged's compiled-program set is CLOSED at
+    warmup (serving adds no keys, no fn re-traces) and strictly below
+    the legacy ``(bucket, kv_limit)`` ladder — both its defined size
+    and its lazily-grown compiled total after identical multi-rung
+    traffic. A warm weight swap keeps every ragged program object and
+    its trace cache (PR 13's id()/_cache_size() technique)."""
+    ragged = _mk_ragged()
+    ladder = _mk(ragged_attention="off")
+    await ragged.start()
+    ladder.tokenizer = ragged.tokenizer
+    await ladder.start()
+    try:
+        # Warmup ledger: one chunk fn (no kv ladder under ragged), one
+        # ragged program per admission width, prefill pinned at the
+        # single S_alloc kv rung (warmup warms the smallest bucket;
+        # the rest fill in lazily but the RUNG axis never grows).
+        S = ragged._S_alloc
+        assert ragged._kv_buckets == (S,)
+        assert set(ragged._ragged_chunk_fns) == {(32, False), (64, False)}
+        assert set(ragged._pool_prefill_fns) == {(32, S)}
+        ladder_defined = (len(ladder.prefill_buckets)
+                          * len(ladder._pool_prefill_kv_buckets)
+                          + len(ladder._kv_buckets))
+        # The ragged set's CEILING: every chunk/ragged program plus one
+        # prefill per bucket — still strictly under the ladder's zoo.
+        ragged_ceiling = (len(ragged._batch_chunk_fns)
+                          + len(ragged._ragged_chunk_fns)
+                          + len(ragged.prefill_buckets))
+        assert ragged_ceiling < ladder_defined, (ragged_ceiling,
+                                                 ladder_defined)
+
+        fn_sets = lambda eng: {  # noqa: E731
+            "chunk": dict(eng._batch_chunk_fns),
+            "ragged": dict(eng._ragged_chunk_fns),
+            "prefill": dict(eng._pool_prefill_fns)}
+        snap = lambda eng: {  # noqa: E731
+            grp: {key: (id(f), f._cache_size())
+                  for key, f in fns.items()}
+            for grp, fns in fn_sets(eng).items()}
+        warm = snap(ragged)
+
+        # Multi-rung traffic: prompts landing in both buckets at both
+        # legacy kv rungs (a >128-token prompt's tail chunk prefills at
+        # the 192 rung) — the ladder engine must lazily grow its
+        # (bucket, kv_limit) zoo; the ragged engine adds at most the
+        # second bucket's prefill, pinned at the same single rung.
+        prompts = ["list pods",                          # (32, 128)
+                   "describe the deployment named web",  # (64, 128)
+                   "x" * 150,                            # tail (32, 192)
+                   "y" * 180]                            # tail (64, 192)
+        for eng in (ragged, ladder):
+            for p in prompts:
+                await eng.generate(p, max_tokens=8, temperature=0.0)
+        after = snap(ragged)
+        assert after["chunk"] == warm["chunk"], "chunk fn re-traced"
+        assert after["ragged"] == warm["ragged"], \
+            "serving re-traced or grew the ragged program set"
+        assert set(ragged._pool_prefill_fns) == {(32, S), (64, S)}
+        assert all(f._cache_size() == 1
+                   for f in ragged._pool_prefill_fns.values())
+        steady_total = _program_total(ragged)
+        assert steady_total == ragged_ceiling
+        grown = _program_total(ladder)
+        assert len(ladder._pool_prefill_fns) \
+            > len(ladder.prefill_buckets), dict(ladder._pool_prefill_fns)
+        assert steady_total < grown, (steady_total, grown)
+        warm = after
+
+        # Warm swap: different weights, same programs, same trace
+        # caches — byte streams change, the ledger does not.
+        t1 = (await ragged.generate("get pods", max_tokens=8)).text
+        await ragged.stop()
+        ragged.swap_weights("/tmp/ragged-dev-ckpt-v2")
+        await ragged.start()
+        assert snap(ragged) == warm, "the swap re-traced a program"
+        t2 = (await ragged.generate("get pods", max_tokens=8)).text
+        assert t2 != t1, "weights did not actually swap"
+        assert snap(ragged) == warm
+    finally:
+        await asyncio.gather(ragged.stop(), ladder.stop())
+
+
+@pytest.mark.slow
+async def test_jax_ragged_containment_reset_keeps_programs_warm():
+    """decode:nan mid-batch under ragged: the poisoned request 410s,
+    bystanders replay byte-identically through the SAME ragged programs
+    (containment reset must not re-trace), and the books balance."""
+    base = _mk_ragged()
+    await base.start()
+    prompts = ["poison target x", "bystander a", "bystander b"]
+    want = {}
+    for p in prompts[1:]:
+        want[p] = (await base.generate(p, max_tokens=8,
+                                       temperature=0.0)).text
+    await base.stop()
+
+    inj = FaultInjector()
+    inj.set("decode", "nan")
+    inj.target_substr = "poison target"
+    eng = _mk_ragged(faults=inj)
+    await eng.start()
+    try:
+        warm = {key: (id(f), f._cache_size())
+                for key, f in eng._ragged_chunk_fns.items()}
+        results = await asyncio.gather(
+            *[eng.generate(p, max_tokens=8, temperature=0.0)
+              for p in prompts],
+            return_exceptions=True)
+        assert isinstance(results[0], RequestQuarantined)
+        for p, r in zip(prompts[1:], results[1:]):
+            assert r.text == want[p], f"victim {p!r} transcript changed"
+        assert {key: (id(f), f._cache_size())
+                for key, f in eng._ragged_chunk_fns.items()} == warm, \
+            "containment reset re-traced the ragged programs"
+        _books(eng)
+    finally:
+        await eng.stop()

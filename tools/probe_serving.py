@@ -131,6 +131,7 @@ async def print_pipeline_summary(session, base_url: str, headers) -> None:
     if consumed:
         log(f"  wasted steps / consumed chunk {wasted / consumed:>8.2f}")
     print_containment_summary(gauges)
+    print_attention_regime(gauges)
     print_mesh_summary(gauges)
     print_kv_pool_summary(gauges)
     print_grammar_summary(gauges)
@@ -175,6 +176,26 @@ def print_containment_summary(gauges: Dict[str, float]) -> None:
     log(f"  slot health trips total     {trips or 0:>8.0f}")
     log(f"  replayed tokens total       "
         f"{gauges.get('replayed_tokens_total', 0.0):>8.0f}")
+
+
+def print_attention_regime(gauges: Dict[str, float]) -> None:
+    """Which attention path is actually serving decode (ISSUE 19):
+    the enum gauge ``decode_attention_regime{regime=...}`` carries 1 on
+    exactly one label — ragged (single paged kernel), paged (legacy
+    in-chunk ladder), gather (ragged requested but KV heads don't
+    divide tp / KV is int8), or dense (no block pool at all)."""
+    regimes = _sum_labelled(gauges, "decode_attention_regime")
+    active = [k.split("=")[-1].strip('"') for k, v in regimes.items()
+              if v >= 1.0]
+    if not active:
+        return      # engine predating the regime gauge
+    note = {"ragged": "one kernel for prefill/decode/verify",
+            "paged": "legacy per-bucket pool ladder",
+            "gather": "ragged fell back — KV gathered densely",
+            "dense": "no block pool (dense KV ladder)"}
+    log("probe[attention]: decode attention regime")
+    for r in active:
+        log(f"  regime                      {r:>8}  ({note.get(r, '?')})")
 
 
 def print_mesh_summary(gauges: Dict[str, float]) -> None:
