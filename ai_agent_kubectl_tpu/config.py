@@ -287,6 +287,14 @@ class ServiceConfig:
     # LRU budget (blocks) the radix tree may keep cached. 0 = auto
     # (a quarter of the pool).
     radix_lru_blocks: int = 0               # RADIX_LRU_BLOCKS
+    # --- two-tier KV: host-RAM block offload (ISSUE 20) ---
+    # Capacity (blocks) of the pinned host-RAM second tier behind the
+    # radix tree: eviction under HBM pressure DEMOTES cold chains there
+    # (CRC32-stamped) instead of discarding them, and a returning
+    # session's match transparently onloads them back — checksum
+    # verified, falling back to ordinary suffix prefill on any failure.
+    # 0 disables the tier (eviction discards, the single-tier world).
+    host_kv_blocks: int = 0                 # HOST_KV_BLOCKS
     # --- grammar-constrained decoding (ISSUE 11; constrain/) ---
     # Compile the kubectl grammar against the tokenizer into a token
     # FSM, mask logits device-side so only grammar-legal tokens can be
@@ -403,6 +411,12 @@ class ServiceConfig:
     # waiting is shed with a fast 429 (the flooding tenant's problem,
     # not everyone's 503). 0 = no cap below MAX_QUEUE_DEPTH.
     tenant_max_queue: int = 0               # TENANT_MAX_QUEUE
+    # Per-session token budget (ISSUE 20): once a session (X-Session-ID
+    # header) has been delivered this many completion tokens, its later
+    # requests classify into the background lane — the session keeps
+    # working, it just stops outranking fresh interactive traffic.
+    # Graceful by design: never a reject. 0 disables budgets.
+    qos_session_token_budget: int = 0       # QOS_SESSION_TOKEN_BUDGET
     # Preemptive decode: once a higher-lane request has queue-waited
     # this long with every slot busy, the scheduler exports the
     # cheapest lower-lane victim (PR 6 RequestExport path), frees its
@@ -477,6 +491,11 @@ class ServiceConfig:
     # 0 disables the TTFT slo (queue-wait burn still runs off
     # SLO_INTERACTIVE_MS).
     slo_ttft_ms: float = 5000.0             # SLO_TTFT_MS
+    # Turn-N TTFT SLO for returning sessions (ISSUE 20): judged ONLY
+    # for radix-warm re-admissions (the match covered at least one full
+    # page), so it prices exactly what the two-tier KV cache exists for
+    # — a warm agent turn must start streaming this fast. 0 disables.
+    slo_session_ttft_ms: float = 0.0        # SLO_SESSION_TTFT_MS
     # Burn-rate windows (seconds, ascending, at most 4 — each is a
     # metric label value): the classic fast/slow multi-window pair.
     slo_windows: str = "300,3600"           # SLO_WINDOWS
@@ -521,6 +540,11 @@ class ServiceConfig:
     # to each new bundle (jax engines only). 0 = off (the default —
     # captures are tens of MB and cost real device time).
     incident_profile_secs: float = 0.0      # INCIDENT_PROFILE_SECS
+    # host_tier_thrash trigger sensitivity (ISSUE 20): both the demote
+    # AND onload deltas since the last evaluation must reach this many
+    # blocks to file a churn incident (one-way flow is warmup/drain,
+    # not thrash). 0 disables the trigger.
+    incident_thrash_min_blocks: int = 8     # INCIDENT_THRASH_MIN_BLOCKS
     # Optional canary-vs-stable step-time verdict in the weight-rollout
     # promotion gate: the canary rolls back when its decode p95 reaches
     # this multiple of the stable cohort's. 0 = off; >= 1 otherwise.
@@ -641,6 +665,32 @@ class ServiceConfig:
             raise ValueError(
                 f"RADIX_LRU_BLOCKS must be >= 0 (0 = auto), "
                 f"got {self.radix_lru_blocks}")
+        # Two-tier KV + session knobs (ISSUE 20): negative capacities
+        # and budgets must refuse to boot, and the host tier only means
+        # something over the block pool + radix tree it demotes from.
+        if self.host_kv_blocks < 0:
+            raise ValueError(
+                f"HOST_KV_BLOCKS must be >= 0 (0 disables the host "
+                f"tier), got {self.host_kv_blocks}")
+        if self.host_kv_blocks > 0 and not (self.kv_pool
+                                            and self.radix_cache):
+            raise ValueError(
+                "HOST_KV_BLOCKS requires KV_POOL=true and "
+                "RADIX_CACHE=true (the host tier is the radix tree's "
+                "demotion target — without the tree there is nothing "
+                "to demote)")
+        if self.slo_session_ttft_ms < 0:
+            raise ValueError(
+                f"SLO_SESSION_TTFT_MS must be >= 0 (0 disables), "
+                f"got {self.slo_session_ttft_ms}")
+        if self.qos_session_token_budget < 0:
+            raise ValueError(
+                f"QOS_SESSION_TOKEN_BUDGET must be >= 0 (0 disables), "
+                f"got {self.qos_session_token_budget}")
+        if self.incident_thrash_min_blocks < 0:
+            raise ValueError(
+                f"INCIDENT_THRASH_MIN_BLOCKS must be >= 0 (0 disables), "
+                f"got {self.incident_thrash_min_blocks}")
         # Ragged attention knob (ISSUE 19): a typo'd mode must refuse
         # to boot, not silently serve the legacy ladder behind a knob
         # that says otherwise. "on" additionally needs the pool (ragged
@@ -824,6 +874,7 @@ class ServiceConfig:
             kv_pool_blocks=_env_int("KV_POOL_BLOCKS", 0),
             radix_cache=_env_bool("RADIX_CACHE", True),
             radix_lru_blocks=_env_int("RADIX_LRU_BLOCKS", 0),
+            host_kv_blocks=_env_int("HOST_KV_BLOCKS", 0),
             grammar_decode=_env_bool("GRAMMAR_DECODE", False),
             grammar_profile=(_env_str("GRAMMAR_PROFILE", "default")
                              or "default").lower(),
@@ -851,6 +902,8 @@ class ServiceConfig:
                 or "interactive").lower(),
             lane_weights=_env_str("LANE_WEIGHTS", "") or "",
             tenant_max_queue=_env_int("TENANT_MAX_QUEUE", 0),
+            qos_session_token_budget=_env_int(
+                "QOS_SESSION_TOKEN_BUDGET", 0),
             preempt_wait_ms=_env_float("PREEMPT_WAIT_MS", 500.0),
             preempt_budget=_env_int("PREEMPT_BUDGET", 2),
             slo_interactive_ms=_env_float("SLO_INTERACTIVE_MS", 2000.0),
@@ -867,6 +920,7 @@ class ServiceConfig:
             flight_recorder_size=_env_int("FLIGHT_RECORDER_SIZE", 256),
             ledger_enable=_env_bool("LEDGER_ENABLE", True),
             slo_ttft_ms=_env_float("SLO_TTFT_MS", 5000.0),
+            slo_session_ttft_ms=_env_float("SLO_SESSION_TTFT_MS", 0.0),
             slo_windows=_env_str("SLO_WINDOWS", "300,3600") or "300,3600",
             slo_objective=_env_float("SLO_OBJECTIVE", 0.99),
             perf_baselines=_env_str("PERF_BASELINES", "") or "",
@@ -882,6 +936,8 @@ class ServiceConfig:
                 "INCIDENT_BURN_THRESHOLD", 2.0),
             incident_profile_secs=_env_float(
                 "INCIDENT_PROFILE_SECS", 0.0),
+            incident_thrash_min_blocks=_env_int(
+                "INCIDENT_THRASH_MIN_BLOCKS", 8),
             rollout_steptime_gate=_env_float(
                 "ROLLOUT_STEPTIME_GATE", 0.0),
             debug_token=_env_str("DEBUG_TOKEN", None),

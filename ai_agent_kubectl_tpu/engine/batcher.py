@@ -51,7 +51,8 @@ from ..obs.ledger import (CLASS_DELIVERED, CLASS_DRAFT_REJECTED,
                           CLASS_HEDGE_LOSER, CLASS_PREEMPTED,
                           CLASS_QUARANTINE_BURN, CLASS_REPLAYED,
                           CLASS_WASTED_MASKED, GoodputLedger)
-from ..obs.slo import SLO_QUEUE_WAIT, SLO_TTFT, SloEngine
+from ..obs.slo import (SLO_QUEUE_WAIT, SLO_SESSION_TTFT, SLO_TTFT,
+                       SloEngine)
 from ..obs.steptime import (PHASE_DECODE, PHASE_PREFILL,
                             PHASE_SPEC_VERIFY, StepTimeSentinel,
                             prefill_bucket)
@@ -62,7 +63,8 @@ from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
                           CAUSE_SLOT_HEALTH, PROBATION_CLEAN_CHUNKS,
                           REASON_HEALTH, REASON_ISOLATED, EngineSupervisor)
 from .jax_engine import JaxEngine
-from .kv_pool import (BlockPool, alloc_with_evict, map_prefix, pages_for)
+from .kv_pool import (BlockPool, HostBlockStore, alloc_with_evict,
+                      map_prefix, pages_for)
 from .radix_cache import RadixCache
 from .protocol import (HEALTH_GRAMMAR_DEAD, HEALTH_NONFINITE,
                        HEALTH_TOKEN_RANGE, EngineOverloaded,
@@ -71,7 +73,8 @@ from .protocol import (HEALTH_GRAMMAR_DEAD, HEALTH_NONFINITE,
                        consume_chunk_row, describe_health, pack_chunk,
                        scan_chunk_row, unpack_chunk)
 from .qos import (ANON_TENANT, LANE_BACKGROUND, LANE_BATCH, LANE_INTERACTIVE,
-                  LANES, BrownoutController, QoSQueue, current_qos, lane_rank)
+                  LANES, BrownoutController, QoSQueue, SessionBudgets,
+                  current_qos, lane_rank)
 from .sampling import eos_mask, greedy_tokens, sample_tokens_seeded
 from .tokenizer import StreamDecoder
 
@@ -878,6 +881,11 @@ class _Request:
     # readonly clamp, or an installed allowed-verbs variant). -1 =
     # unconstrained (GRAMMAR_DECODE off).
     gpid: int = -1
+    # Session plane (ISSUE 20): the namespaced session id (empty =
+    # sessionless) and whether admission radix-matched at least one
+    # full page — the gate on the turn-N session TTFT SLO.
+    session: str = ""
+    radix_warm: bool = False
 
 
 @dataclasses.dataclass
@@ -943,6 +951,7 @@ class BatchedJaxEngine(JaxEngine):
                  kv_pool_blocks: int = 0,
                  radix_cache: bool = True,
                  radix_lru_blocks: int = 0,
+                 host_kv_blocks: int = 0,
                  grammar_decode: bool = False,
                  grammar_profile: str = "default",
                  grammar_forced_run_min: int = 4,
@@ -967,6 +976,8 @@ class BatchedJaxEngine(JaxEngine):
                  slo_interactive_ms: float = 0.0,
                  ledger_enable: bool = True,
                  slo_ttft_ms: float = 0.0,
+                 slo_session_ttft_ms: float = 0.0,
+                 session_token_budget: int = 0,
                  slo_windows: tuple = (300, 3600),
                  slo_objective: float = 0.99,
                  sentinel_enable: bool = True,
@@ -1033,6 +1044,10 @@ class BatchedJaxEngine(JaxEngine):
         self.kv_pool_blocks = max(0, kv_pool_blocks)
         self.radix_cache = bool(radix_cache)
         self.radix_lru_blocks = max(0, radix_lru_blocks)
+        # Two-tier KV (ISSUE 20): pinned host-RAM capacity (blocks)
+        # behind the radix tree; 0 keeps the single-tier world.
+        self.host_kv_blocks = max(0, host_kv_blocks)
+        self._host_store: Optional[HostBlockStore] = None
         self._use_pool = False        # resolved at start (mesh fallback)
         # True when KV_POOL was requested but the mesh forced the dense
         # ladder (data/pipe/seq axes >1 — the pool's block axis is a
@@ -1156,8 +1171,14 @@ class BatchedJaxEngine(JaxEngine):
         # controller as an early-trim signal.
         self.ledger = GoodputLedger(enabled=ledger_enable)
         self._slo = SloEngine(
-            {SLO_TTFT: slo_ttft_ms, SLO_QUEUE_WAIT: slo_interactive_ms},
+            {SLO_TTFT: slo_ttft_ms, SLO_QUEUE_WAIT: slo_interactive_ms,
+             SLO_SESSION_TTFT: slo_session_ttft_ms},
             objective=slo_objective, windows=tuple(slo_windows))
+        # Per-session token budgets (ISSUE 20): charged at delivery on
+        # the scheduler thread, read at classification on the event
+        # loop — same policy object type as the fake so budget
+        # semantics can't diverge.
+        self._session_budgets = SessionBudgets(session_token_budget)
         # Perf-regression sentinel (ISSUE 15, obs/steptime.py): one
         # sample per decode-chunk cycle (the dispatch-to-dispatch
         # interval while the pipe stays busy — it covers exactly one
@@ -1308,6 +1329,7 @@ class BatchedJaxEngine(JaxEngine):
             kv_pool_blocks=cfg.kv_pool_blocks,
             radix_cache=cfg.radix_cache,
             radix_lru_blocks=cfg.radix_lru_blocks,
+            host_kv_blocks=cfg.host_kv_blocks,
             grammar_decode=cfg.grammar_decode,
             grammar_profile=cfg.grammar_profile,
             grammar_forced_run_min=cfg.grammar_forced_run_min,
@@ -1330,6 +1352,8 @@ class BatchedJaxEngine(JaxEngine):
             slo_interactive_ms=cfg.slo_interactive_ms,
             ledger_enable=cfg.ledger_enable,
             slo_ttft_ms=cfg.slo_ttft_ms,
+            slo_session_ttft_ms=cfg.slo_session_ttft_ms,
+            session_token_budget=cfg.qos_session_token_budget,
             slo_windows=cfg.slo_window_list,
             slo_objective=cfg.slo_objective,
             sentinel_enable=cfg.sentinel_enable,
@@ -2191,9 +2215,20 @@ class BatchedJaxEngine(JaxEngine):
             # re-allocate; the radix tree repopulates organically).
             self._cache = self._new_pool_cache()
             prev_pool, prev_radix = self._pool, self._radix
+            prev_store = self._host_store
             self._pool = BlockPool(self._pool_n_blocks, self.kv_pool_page)
+            # Two-tier rebuild (ISSUE 20): a reset condemns the host
+            # tier too — its payloads were gathered from the poisoned
+            # device world — so BOTH tiers restart empty.
+            self._host_store = (
+                HostBlockStore(self.host_kv_blocks)
+                if self.host_kv_blocks > 0 and self.radix_cache else None)
             self._radix = (RadixCache(self._pool,
-                                      max_blocks=self.radix_lru_blocks)
+                                      max_blocks=self.radix_lru_blocks,
+                                      host_store=self._host_store,
+                                      offload_fn=self._pool_offload_block,
+                                      onload_fn=self._pool_onload_block,
+                                      faults=self.faults)
                            if self.radix_cache else None)
             # Cumulative counters survive the rebuild — the /metrics
             # delta-mirror must never see totals go backwards.
@@ -2201,6 +2236,8 @@ class BatchedJaxEngine(JaxEngine):
                 self._pool.carry_counters(prev_pool)
             if prev_radix is not None and self._radix is not None:
                 self._radix.carry_counters(prev_radix)
+            if prev_store is not None and self._host_store is not None:
+                self._host_store.carry_counters(prev_store)
             self._tables = np.full((N, self._pool_max_pages),
                                    self._pool_n_blocks, np.int32)
         else:
@@ -2449,6 +2486,41 @@ class BatchedJaxEngine(JaxEngine):
             self._cache, jnp.asarray(src, jnp.int32),
             jnp.asarray(dst, jnp.int32), jnp.asarray(rows, jnp.int32))
 
+    # ------------------------------- host-tier block transfer (ISSUE 20)
+
+    def _pool_offload_block(self, block: int) -> np.ndarray:
+        """Gather one pool block's KV rows off the device as a flat byte
+        payload (demote path). Leaf order follows the cache pytree
+        (QuantKV under int8 contributes q and s leaves), so onload can
+        split the bytes back by the same walk — the checksum stamped
+        over this buffer covers every quantized leaf too."""
+        leaves = jax.tree_util.tree_leaves((self._cache.k, self._cache.v))
+        parts = [np.ascontiguousarray(jax.device_get(leaf[:, block]))
+                 for leaf in leaves]
+        return np.concatenate(
+            [p.reshape(-1).view(np.uint8) for p in parts])
+
+    def _pool_onload_block(self, block: int, data: np.ndarray) -> None:
+        """Write a demoted page's verified bytes back into pool block
+        ``block`` (promote path). The split mirrors _pool_offload_block's
+        leaf walk; placement (mesh sharding) is preserved by the .at
+        scatter on the existing leaves."""
+        flat = np.ascontiguousarray(np.asarray(data, dtype=np.uint8))
+        kv, treedef = jax.tree_util.tree_flatten(
+            (self._cache.k, self._cache.v))
+        off, out = 0, []
+        for leaf in kv:
+            sub = (leaf.shape[0],) + tuple(leaf.shape[2:])
+            dt = np.dtype(leaf.dtype)
+            n = int(np.prod(sub)) * dt.itemsize
+            part = np.frombuffer(
+                flat[off:off + n].tobytes(), dtype=dt).reshape(sub)
+            off += n
+            out.append(leaf.at[:, block].set(
+                jnp.asarray(part, dtype=leaf.dtype)))
+        k, v = jax.tree_util.tree_unflatten(treedef, out)
+        self._cache = KVCache(k=k, v=v, lengths=self._cache.lengths)
+
     def _pool_alloc(self, n: int) -> Optional[List[int]]:
         """Allocate with radix-eviction backpressure (kv_pool.py helper,
         shared verbatim with the fake engine)."""
@@ -2592,6 +2664,11 @@ class BatchedJaxEngine(JaxEngine):
                 run, ends_eos = [], False
         full = ids + run
         blocks, m = self._pool_map_prefix(ids)
+        # Session SLO gate (ISSUE 20): a seating that radix-matched at
+        # least one full page is a warm re-admission — the only kind the
+        # turn-N TTFT SLO judges (onload-served pages count: the match
+        # promoted them before recording the hit).
+        req.radix_warm = m >= self.kv_pool_page
         try:
             grow = pages_for(len(full), self.kv_pool_page) - len(blocks)
             if grow > 0:
@@ -2898,6 +2975,8 @@ class BatchedJaxEngine(JaxEngine):
         body["attention_regime"] = self._attention_regime
         body["radix"] = (self._radix.stats() if self._radix is not None
                          else None)
+        if self._host_store is not None:
+            body["host_tier"] = self._host_store.stats()
         return body
 
     # ----------------------------------- speculative decoding (ISSUE 12)
@@ -4430,6 +4509,7 @@ class BatchedJaxEngine(JaxEngine):
                 1 for t in list(self._preempt_times) if t >= now - 60.0),
             "queue_expired_total": self._admissions.expired_total,
             "queue_displaced_total": self._admissions.displaced_total,
+            "session_budgets": self._session_budgets.snapshot(),
         }
 
     # ------------------------------------------ telemetry plane (ISSUE 8)
@@ -5595,6 +5675,10 @@ class BatchedJaxEngine(JaxEngine):
         self.ledger.record(
             CLASS_HEDGE_LOSER if discarded else CLASS_DELIVERED,
             n_new, lane=lane, tenant=slot.req.tenant)
+        # Session budget (ISSUE 20): only tokens the client actually got
+        # spend budget — hedge-loser burn never demotes a session.
+        if not discarded:
+            self._session_budgets.charge(slot.req.session, n_new)
         if error is not None:
             if slot.req.trace is not None:
                 slot.req.trace.event(
@@ -5615,15 +5699,27 @@ class BatchedJaxEngine(JaxEngine):
             # finish already measures this logical request, and the
             # loser's latency is exactly the stall the hedge papered
             # over (the client never saw it).
-            self._slo.note(
-                SLO_TTFT, lane,
-                ((slot.req.t_first0 or slot.t_first or t_end)
-                 - slot.req.t_submit) * 1000.0,
-                now=t_end)
+            ttft_sample_ms = ((slot.req.t_first0 or slot.t_first or t_end)
+                              - slot.req.t_submit) * 1000.0
+            self._slo.note(SLO_TTFT, lane, ttft_sample_ms, now=t_end)
+            # Turn-N session TTFT (ISSUE 20): judged ONLY for radix-warm
+            # re-admissions of a declared session — the sample set the
+            # two-tier cache is accountable for.
+            if slot.req.session and slot.req.radix_warm:
+                self._slo.note(SLO_SESSION_TTFT, lane, ttft_sample_ms,
+                               now=t_end)
         if slot.req.trace is not None:
             slot.req.trace.event(
                 f"engine: finished ({finish}, "
                 f"{len(slot.detok.ids)} tokens)")
+        # Starvation truncation is client-visible degradation (ISSUE
+        # 20): the transcript stopped short of what decode would have
+        # produced, and the result says so rather than passing it off
+        # as a natural stop.
+        degraded = bool(getattr(slot, "exhausted", False))
+        if degraded and slot.req.trace is not None:
+            slot.req.trace.link("degraded", cause="kv_pool_starved",
+                                tokens=len(slot.detok.ids))
         result = EngineResult(
             text=slot.detok.text,
             prompt_tokens=slot.n_prompt,
@@ -5637,6 +5733,7 @@ class BatchedJaxEngine(JaxEngine):
             finish_reason=finish,
             engine=self.name,
             weights_version=self.weights_version,
+            degraded=degraded,
         )
         self._emit(slot.req, "done", result)
 
@@ -5692,6 +5789,11 @@ class BatchedJaxEngine(JaxEngine):
         tenant = (qctx.tenant if qctx is not None else "") or ANON_TENANT
         lane = (qctx.lane if qctx is not None
                 and qctx.lane in LANES else LANE_INTERACTIVE)
+        session = qctx.session if qctx is not None else ""
+        # Over-budget sessions classify into the background lane (ISSUE
+        # 20): the session keeps working — WDRR guarantees background a
+        # share — but stops outranking fresh interactive traffic.
+        lane = self._session_budgets.lane_for(session, lane)
         trace = current_trace()
         # Grammar resolution (ISSUE 11): base profile, clamped readonly
         # for the background tier (TENANT_TIERS floor) or an explicit
@@ -5751,6 +5853,7 @@ class BatchedJaxEngine(JaxEngine):
             ledger_delivered=len(resume_ids) if resume_ids else 0,
             ttft_exempt=bool(resume_ids),
             gpid=gpid,
+            session=session,
         )
         if export is not None:
             # Version the portable state at submit: ids this engine

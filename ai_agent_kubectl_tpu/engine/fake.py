@@ -29,7 +29,8 @@ from ..obs.ledger import (CLASS_DELIVERED, CLASS_DRAFT_REJECTED,
                           CLASS_HEDGE_LOSER, CLASS_PREEMPTED,
                           CLASS_QUARANTINE_BURN, CLASS_REPLAYED,
                           CLASS_WASTED_MASKED, GoodputLedger)
-from ..obs.slo import SLO_QUEUE_WAIT, SLO_TTFT, SloEngine
+from ..obs.slo import (SLO_QUEUE_WAIT, SLO_SESSION_TTFT, SLO_TTFT,
+                       SloEngine)
 from ..obs.steptime import (PHASE_DECODE, PHASE_PREFILL,
                             PHASE_SPEC_VERIFY, StepTimeSentinel,
                             prefill_bucket)
@@ -38,8 +39,8 @@ from .containment import (CAUSE_SCHEDULER_DEATH, CAUSE_SCHEDULER_ERROR,
                           CAUSE_SLOT_HEALTH, PROBATION_CLEAN_CHUNKS,
                           REASON_HEALTH, REASON_ISOLATED, EngineSupervisor)
 from .fallback import extract_query, rule_command  # rules promoted there
-from .kv_pool import (BlockPool, PoolExhausted, alloc_with_evict,
-                      map_prefix, pages_for)
+from .kv_pool import (BlockPool, HostBlockStore, PoolExhausted,
+                      alloc_with_evict, map_prefix, pages_for)
 from .radix_cache import RadixCache
 from .protocol import (HEALTH_GRAMMAR_DEAD, HEALTH_NONFINITE,
                        EngineOverloaded, EngineResult, EngineUnavailable,
@@ -47,7 +48,8 @@ from .protocol import (HEALTH_GRAMMAR_DEAD, HEALTH_NONFINITE,
                        RequestQuarantined, consume_chunk_row, pack_chunk,
                        scan_chunk_row, unpack_chunk)
 from .qos import (ANON_TENANT, LANE_BACKGROUND, LANE_BATCH, LANE_INTERACTIVE,
-                  LANES, BrownoutController, QoSQueue, current_qos, lane_rank)
+                  LANES, BrownoutController, QoSQueue, SessionBudgets,
+                  current_qos, lane_rank)
 
 
 class FakeEngine:
@@ -186,6 +188,12 @@ class _FakeReq:
     # Grammar-constrained decoding mirror (ISSUE 11): the resolved
     # grammar profile id (-1 = unconstrained).
     gpid: int = -1
+    # Session plane (ISSUE 20): the namespaced session id (empty =
+    # sessionless) and whether admission radix-matched at least one full
+    # page — the gate on the turn-N TTFT SLO (only returning warm turns
+    # price the two-tier cache).
+    session: str = ""
+    radix_warm: bool = False
 
 
 @dataclasses.dataclass
@@ -249,6 +257,9 @@ class FakeChunkedEngine:
                  kv_pool_blocks: int = 0,
                  radix_cache: bool = True,
                  radix_lru_blocks: int = 0,
+                 host_kv_blocks: int = 0,
+                 slo_session_ttft_ms: float = 0.0,
+                 session_token_budget: int = 0,
                  ragged_attention: str = "auto",
                  grammar_decode: bool = False,
                  grammar_profile: str = "default",
@@ -304,8 +315,13 @@ class FakeChunkedEngine:
         # conservation invariant is assertable in milliseconds.
         self.ledger = GoodputLedger(enabled=ledger_enable)
         self._slo = SloEngine(
-            {SLO_TTFT: slo_ttft_ms, SLO_QUEUE_WAIT: slo_interactive_ms},
+            {SLO_TTFT: slo_ttft_ms, SLO_QUEUE_WAIT: slo_interactive_ms,
+             SLO_SESSION_TTFT: slo_session_ttft_ms},
             objective=slo_objective, windows=tuple(slo_windows))
+        # Per-session token budgets (ISSUE 20): charged at delivery,
+        # read at classification — both engines share the policy object
+        # type so budget semantics can't diverge.
+        self._session_budgets = SessionBudgets(session_token_budget)
         # Perf-regression sentinel (ISSUE 15) — the SAME StepTimeSentinel
         # the batcher runs, fed by the same dispatch-interval scheme, so
         # the whole sentinel → trigger → incident chain runs in tier-1:
@@ -368,8 +384,12 @@ class FakeChunkedEngine:
                                          self.kv_pool_page)
         self._pool_n_blocks = (max(0, kv_pool_blocks)
                                or batch_size * self._pool_max_pages)
+        # Two-tier KV (ISSUE 20): host-RAM capacity behind the radix
+        # tree; 0 keeps the single-tier world byte-identical.
+        self.host_kv_blocks = max(0, host_kv_blocks)
         self._pool: Optional[BlockPool] = None
         self._radix: Optional[RadixCache] = None
+        self._host_store: Optional[HostBlockStore] = None
         self._pool_starved = 0
         if self.kv_pool:
             self._pool_reset()
@@ -504,14 +524,26 @@ class FakeChunkedEngine:
         and replays re-allocate. Cumulative counters carry over (the
         /metrics delta-mirror must never see totals go backwards)."""
         prev_pool, prev_radix = self._pool, self._radix
+        prev_store = self._host_store
         self._pool = BlockPool(self._pool_n_blocks, self.kv_pool_page)
+        # Two-tier rebuild (ISSUE 20): a containment reset condemns the
+        # host tier too — its payloads were captured from the poisoned
+        # device world — so BOTH tiers restart empty; cumulative demote/
+        # onload counters carry like the pool's.
+        self._host_store = (HostBlockStore(self.host_kv_blocks)
+                            if self.host_kv_blocks > 0 and self.radix_cache
+                            else None)
         self._radix = (RadixCache(self._pool,
-                                  max_blocks=self.radix_lru_blocks)
+                                  max_blocks=self.radix_lru_blocks,
+                                  host_store=self._host_store,
+                                  faults=self.faults)
                        if self.radix_cache else None)
         if prev_pool is not None:
             self._pool.carry_counters(prev_pool)
         if prev_radix is not None and self._radix is not None:
             self._radix.carry_counters(prev_radix)
+        if prev_store is not None and self._host_store is not None:
+            self._host_store.carry_counters(prev_store)
 
     @staticmethod
     def _prompt_token_ids(prompt: str) -> List[int]:
@@ -549,7 +581,12 @@ class FakeChunkedEngine:
         basis = list(req.prompt_ids)
         gen = list(req.resume_ids or [])[:g]
         chain = basis + (gen[:-1] if gen else [])
-        blocks, _ = self._pool_map_prefix(chain, match_all=bool(gen))
+        blocks, m = self._pool_map_prefix(chain, match_all=bool(gen))
+        # Session SLO gate (ISSUE 20): a seating that radix-matched at
+        # least one full page is a warm re-admission — the only kind the
+        # turn-N TTFT SLO judges (onload-served pages count here too:
+        # map_prefix's match promoted them before recording the hit).
+        req.radix_warm = m >= self.kv_pool_page
         return blocks, basis
 
     def _pool_ensure_coverage(self, slot: _FakeSlot,
@@ -606,6 +643,8 @@ class FakeChunkedEngine:
         body["starved_slots_total"] = self._pool_starved
         body["radix"] = (self._radix.stats() if self._radix is not None
                          else None)
+        if self._host_store is not None:
+            body["host_tier"] = self._host_store.stats()
         # ISSUE 19 surface parity: the regime actually serving decode
         # attention (policy mirror — the fake has no kernels).
         body["attention_regime"] = self._attention_regime
@@ -1098,6 +1137,7 @@ class FakeChunkedEngine:
                 1 for t in list(self._preempt_times) if t >= now - 60.0),
             "queue_expired_total": self._queue.expired_total,
             "queue_displaced_total": self._queue.displaced_total,
+            "session_budgets": self._session_budgets.snapshot(),
         }
 
     def _admit_pending(self) -> None:
@@ -1670,6 +1710,10 @@ class FakeChunkedEngine:
                    and getattr(req.export, "discard", False))
                else CLASS_DELIVERED)
         self.ledger.record(cls, n_new, lane=req.lane, tenant=req.tenant)
+        # Session budget (ISSUE 20): only tokens the client actually got
+        # spend budget — hedge-loser burn never demotes a session.
+        if cls == CLASS_DELIVERED:
+            self._session_budgets.charge(req.session, n_new)
 
     def _contain_poisoned_step(self, cause: str, named=(),
                                error: Optional[BaseException] = None) -> None:
@@ -1839,14 +1883,29 @@ class FakeChunkedEngine:
             # t_first0 survives preempt/resume (mirror of the batcher);
             # fleet imports are exempt — their first byte was the
             # donor's.
-            self._slo.note(
-                SLO_TTFT, slot.req.lane if slot.req.lane in LANES
-                else LANE_INTERACTIVE,
-                ((slot.req.t_first0 or slot.t_first or now)
-                 - slot.req.t_submit) * 1000.0,
-                now=now)
-        slot.req.out_queue.put_nowait(
-            ("done", self._result(slot.req, slot.emitted, finish)))
+            ttft_ms = ((slot.req.t_first0 or slot.t_first or now)
+                       - slot.req.t_submit) * 1000.0
+            lane = (slot.req.lane if slot.req.lane in LANES
+                    else LANE_INTERACTIVE)
+            self._slo.note(SLO_TTFT, lane, ttft_ms, now=now)
+            # Turn-N session TTFT (ISSUE 20): judged ONLY for radix-warm
+            # re-admissions of a declared session — the sample set the
+            # two-tier cache is accountable for.
+            if slot.req.session and slot.req.radix_warm:
+                self._slo.note(SLO_SESSION_TTFT, lane, ttft_ms, now=now)
+        # Starvation truncation is a client-visible degradation (ISSUE
+        # 20): the transcript is short of what decode would have
+        # produced, so the result says so instead of passing as a
+        # natural stop.
+        degraded = bool(slot.pool_starved)
+        if degraded and slot.req.trace is not None:
+            slot.req.trace.link("degraded", cause="kv_pool_starved",
+                                tokens=len(slot.emitted))
+        # Stamped AFTER construction: _result is a documented test
+        # override hook, so its signature stays what subclasses expect.
+        result = self._result(slot.req, slot.emitted, finish)
+        result.degraded = result.degraded or degraded
+        slot.req.out_queue.put_nowait(("done", result))
 
     # ------------------------------------------------------------ serving
 
@@ -1906,6 +1965,11 @@ class FakeChunkedEngine:
         tenant = (qctx.tenant if qctx is not None else "") or ANON_TENANT
         lane = (qctx.lane if qctx is not None
                 and qctx.lane in LANES else LANE_INTERACTIVE)
+        session = qctx.session if qctx is not None else ""
+        # Over-budget sessions classify into the background lane (ISSUE
+        # 20): the session keeps working — WDRR guarantees background a
+        # share — but stops outranking fresh interactive traffic.
+        lane = self._session_budgets.lane_for(session, lane)
         gpid = -1
         if self._grammar is not None:
             from ..constrain import current_grammar
@@ -1944,6 +2008,7 @@ class FakeChunkedEngine:
             ledger_delivered=len(resume_ids) if resume_ids else 0,
             ttft_exempt=bool(resume_ids),
             gpid=gpid,
+            session=session,
         )
         if export is not None:
             # Version the portable state at submit (ISSUE 13): the

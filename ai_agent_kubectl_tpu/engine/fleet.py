@@ -1173,7 +1173,9 @@ class EngineFleet:
         rollup is capacity accounting, not a shared address space."""
         agg: dict = {}
         radix: dict = {}
-        seen = radix_seen = False
+        host: dict = {}
+        host_fails: dict = {}
+        seen = radix_seen = host_seen = False
         for rep in self.replicas:
             fn = getattr(rep.engine, "kv_pool_health", None)
             if not callable(fn):
@@ -1193,6 +1195,20 @@ class EngineFleet:
                             # Budgets/counts sum; per-replica-identical
                             # config passes through below.
                             radix[rk] = radix.get(rk, 0) + rv
+                elif k == "host_tier":
+                    if v:
+                        host_seen = True
+                        for hk, hv in v.items():
+                            if hk == "onload_fail_total":
+                                for cause, n in (hv or {}).items():
+                                    host_fails[cause] = (
+                                        host_fails.get(cause, 0) + n)
+                            else:
+                                # capacity/used/free sum like the device
+                                # tier's block counts: each replica owns
+                                # its own host store, so the rollup is
+                                # fleet-wide capacity accounting.
+                                host[hk] = host.get(hk, 0) + hv
                 elif k == "page":
                     # Config, identical per replica — pass through, a
                     # sum would triple the "tokens per block" math any
@@ -1203,6 +1219,9 @@ class EngineFleet:
         if not seen:
             return {}
         agg["radix"] = radix if radix_seen else None
+        if host_seen:
+            host["onload_fail_total"] = host_fails
+            agg["host_tier"] = host
         return agg
 
     def sharding_health(self) -> dict:

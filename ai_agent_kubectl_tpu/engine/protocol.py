@@ -324,6 +324,10 @@ class EngineResult:
     # Weight rollout (ISSUE 13): the checkpoint version of the weights
     # that produced this text ("" for engines without versioning).
     weights_version: str = ""
+    # Graceful degradation (ISSUE 20): True when the engine truncated
+    # this generation short of a natural finish (KV pool starvation) —
+    # the client must see the cut, not mistake it for a model stop.
+    degraded: bool = False
 
     @property
     def tokens_per_sec(self) -> float:

@@ -122,6 +122,10 @@ class QoSContext:
 
     tenant: str = ANON_TENANT
     lane: str = LANE_INTERACTIVE
+    #: client-declared session identity (``X-Session-ID``, ISSUE 20):
+    #: the unit the per-session token budget and the turn-N TTFT SLO
+    #: account on. Empty = sessionless request (budget never applies).
+    session: str = ""
 
 
 _qos_var: ContextVar[Optional[QoSContext]] = ContextVar("qos_context",
@@ -144,7 +148,8 @@ def use_qos(ctx: QoSContext):
 def classify(api_key: Optional[str], client_ip: Optional[str],
              priority_header: Optional[str],
              tiers: Dict[str, str],
-             default_lane: str = LANE_INTERACTIVE) -> QoSContext:
+             default_lane: str = LANE_INTERACTIVE,
+             session: Optional[str] = None) -> QoSContext:
     """Tenant + lane for one request.
 
     Tenant: the API key when presented, else the client IP (the same
@@ -152,7 +157,10 @@ def classify(api_key: Optional[str], client_ip: Optional[str],
     request when valid, else the tenant's tier default — always clamped
     to the tier, so a client can *lower* its own priority freely (a
     polite bulk importer self-labels ``background``) but can never claim
-    a lane above what its tier grants."""
+    a lane above what its tier grants. ``session`` is the raw
+    ``X-Session-ID`` header; it is namespaced under the tenant so one
+    client can never spend (or observe) another tenant's budget by
+    guessing its session string."""
     tenant = (api_key or "").strip() or (client_ip or "").strip() \
         or ANON_TENANT
     tier = tiers.get(tenant, default_lane)
@@ -162,7 +170,82 @@ def classify(api_key: Optional[str], client_ip: Optional[str],
     lane = requested if requested in LANES else tier
     if lane_rank(lane) > lane_rank(tier):
         lane = tier
-    return QoSContext(tenant=tenant, lane=lane)
+    sid = (session or "").strip()
+    return QoSContext(tenant=tenant, lane=lane,
+                      session=f"{tenant}/{sid}" if sid else "")
+
+
+class SessionBudgets:
+    """Per-session completion-token budgets (ISSUE 20).
+
+    A multi-turn agent session is exactly the workload the two-tier KV
+    cache accelerates — which also makes it the workload that can
+    monopolize the engine (every turn re-admits radix-warm and wins the
+    TTFT race against cold strangers). The budget is the counterweight:
+    once a session has been *delivered* ``budget_tokens`` completion
+    tokens, its later turns classify into the background lane. The
+    session keeps working (lanes never starve outright — WDRR guarantees
+    background a share) but stops outranking fresh interactive traffic.
+
+    Accounting is delivered tokens (the billing ledger's unit), charged
+    at finish by the engine scheduler — not at admission — so a shed or
+    failed turn never burns budget. State is a bounded LRU keyed by the
+    namespaced session id (``tenant/session``): at ``max_sessions`` the
+    coldest session's counter is dropped, which *resets* that session's
+    budget — the benign failure mode (a forgotten session regains
+    priority) rather than an unbounded-memory one. ``budget_tokens <= 0``
+    disables the whole mechanism. Thread-safe: charge runs on the
+    scheduler thread, lane_for on the event loop."""
+
+    def __init__(self, budget_tokens: int, *, max_sessions: int = 2048):
+        self.budget_tokens = max(0, int(budget_tokens))
+        self.max_sessions = max(1, int(max_sessions))
+        self._lock = threading.Lock()
+        self._spent: "OrderedDict[str, int]" = OrderedDict()
+        self.demoted_total = 0
+        self.evicted_total = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget_tokens > 0
+
+    def charge(self, session: str, tokens: int) -> None:
+        """Add delivered completion tokens to a session's tally."""
+        if not self.enabled or not session or tokens <= 0:
+            return
+        with self._lock:
+            self._spent[session] = self._spent.get(session, 0) + int(tokens)
+            self._spent.move_to_end(session)
+            while len(self._spent) > self.max_sessions:
+                self._spent.popitem(last=False)
+                self.evicted_total += 1
+
+    def over(self, session: str) -> bool:
+        if not self.enabled or not session:
+            return False
+        with self._lock:
+            return self._spent.get(session, 0) >= self.budget_tokens
+
+    def lane_for(self, session: str, lane: str) -> str:
+        """Clamp an over-budget session to the background lane (counted);
+        requests already there pass through unchanged."""
+        if lane != LANE_BACKGROUND and self.over(session):
+            self.demoted_total += 1
+            return LANE_BACKGROUND
+        return lane
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            over = sum(1 for v in self._spent.values()
+                       if v >= self.budget_tokens) if self.enabled else 0
+            return {
+                "enabled": self.enabled,
+                "budget_tokens": self.budget_tokens,
+                "sessions_tracked": len(self._spent),
+                "sessions_over_budget": over,
+                "demoted_total": self.demoted_total,
+                "evicted_total": self.evicted_total,
+            }
 
 
 # TenantOverloaded lives in engine.protocol (it must subclass

@@ -31,6 +31,24 @@ the LRU walk drops childless nodes (tails first), decref'ing their blocks
 its *cached* state ends. ``max_blocks`` bounds the tree's held blocks
 (RADIX_LRU_BLOCKS); ``evict_for`` frees pool pressure on demand.
 
+Two-tier demotion (ISSUE 20): with a ``HostBlockStore`` attached, the
+eviction walk *demotes* a cold page to pinned host RAM (CRC32 stamped)
+instead of discarding it — the node stays in the tree holding a host
+block id (``_Node.host``) and no device block. Host-resident nodes form
+bottom-hanging subtrees by construction: a node may give up its device
+block only once ALL its children are host-resident (or it has none), and
+``match`` promotes top-down, so a host node's parent is never below it.
+``match`` transparently re-onloads host pages it walks into — verified
+against the demote-time checksum; a corrupt or allocation-starved onload
+ends the match there (the caller prefills the suffix — zero failed
+requests, counted per cause), and a corrupt page's whole host subtree is
+dropped. The LRU clock spans both tiers: when the host store is full,
+host leaves older than the incoming demote are dropped first; an
+incoming page older than every resident one is discarded, exactly the
+single-tier behaviour. ``offload:fail`` / ``onload:corrupt`` drill
+points (testing/faults.py) are consumed through the duck-typed
+``faults`` hook so both engines inherit them.
+
 Host-side, numpy/stdlib only; single-writer (scheduler thread / event
 loop) like the pool itself.
 """
@@ -42,7 +60,9 @@ import heapq
 import itertools
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from .kv_pool import BlockPool
+import numpy as np
+
+from .kv_pool import BlockPool, HostBlockStore, alloc_with_evict
 
 
 @dataclasses.dataclass
@@ -63,24 +83,54 @@ class MatchResult:
 
 
 class _Node:
-    __slots__ = ("children", "block", "tail", "parent", "key", "last")
+    __slots__ = ("children", "block", "host", "tail", "parent", "key",
+                 "last")
 
     def __init__(self, parent: Optional["_Node"], key: Optional[tuple],
                  block: Optional[int]):
         self.children: Dict[tuple, _Node] = {}
         self.block = block           # pool block of this node's page
+        # Host tier (ISSUE 20): exactly one of block/host is set for a
+        # non-root node. host is the HostBlockStore id of the demoted
+        # page; block is None while host-resident.
+        self.host: Optional[int] = None
         self.parent = parent
         self.key = key               # page token tuple (None at root)
         # (tokens tuple, block id, rows) — the partial page below this
-        # node, or None.
+        # node, or None. Tails are never demoted (a partial page is the
+        # least shareable KV — it drops first instead).
         self.tail: Optional[Tuple[tuple, int, int]] = None
-        self.last = 0                # LRU stamp (monotonic counter)
+        self.last = 0                # LRU stamp (monotonic, BOTH tiers)
 
 
 class RadixCache:
-    def __init__(self, pool: BlockPool, *, max_blocks: int = 0):
+    def __init__(self, pool: BlockPool, *, max_blocks: int = 0,
+                 host_store: Optional[HostBlockStore] = None,
+                 offload_fn=None, onload_fn=None, faults=None):
         self.pool = pool
         self.page = pool.page
+        # Host tier (ISSUE 20): demote target for cold pages. offload_fn
+        # (block -> np.ndarray) reads the page's device KV at demote;
+        # onload_fn(block, data) writes it back at promote. The fake
+        # engine passes neither — its payload is the page's token tuple,
+        # so the checksum round-trip is still real. ``faults`` is the
+        # duck-typed injector view (offload_fail()/onload_corrupt()).
+        self.host_store = host_store if (
+            host_store is not None and host_store.capacity > 0) else None
+        self.offload_fn = offload_fn
+        self.onload_fn = onload_fn
+        self.faults = faults
+        # hbid -> node holding it (exactly one — the host-tier ownership
+        # invariant HostBlockStore.check asserts).
+        self._host_nodes: Dict[int, _Node] = {}
+        # LRU stamp of the match walk currently in flight: eviction
+        # triggered by a mid-walk promote must never demote/drop the
+        # walk's own path (the recorded blocks are incref'd in bulk only
+        # at the end). 0 = no walk in flight.
+        self._protect_stamp = 0
+        # True while clear() drains: the reset condemns cached KV, so
+        # eviction must plain-drop, never demote it into the host store.
+        self._demote_suspended = False
         # 0 = auto: a quarter of the pool may sit cached — enough to keep
         # the system prompt + recent agent histories hot without starving
         # live admissions.
@@ -149,20 +199,32 @@ class RadixCache:
         """Longest cached prefix of ``ids``: full pages walked exactly,
         then at most one partial-tail match. Matched blocks are incref'd
         for the caller (see MatchResult). Counters: ``hit_tokens_total``
-        gains the match, ``miss_tokens_total`` the unmatched remainder."""
+        gains the match, ``miss_tokens_total`` the unmatched remainder.
+
+        Host-resident pages on the path are transparently promoted
+        (checksum-verified onload, ISSUE 20); a failed promote — device
+        tier full even after eviction, or a corrupt host copy — ends the
+        match there and the caller prefills the suffix, so the host tier
+        can degrade a hit into a prefill but never fail a request."""
         page = self.page
         node, n = self._root, 0
         blocks: List[int] = []
         stamp = next(self._clock)
         node.last = stamp
-        while len(ids) - n >= page:
-            child = node.children.get(tuple(ids[n:n + page]))
-            if child is None:
-                break
-            blocks.append(child.block)
-            node = child
-            node.last = stamp
-            n += page
+        self._protect_stamp = stamp
+        try:
+            while len(ids) - n >= page:
+                child = node.children.get(tuple(ids[n:n + page]))
+                if child is None:
+                    break
+                child.last = stamp
+                if child.block is None and not self._promote(child):
+                    break
+                blocks.append(child.block)
+                node = child
+                n += page
+        finally:
+            self._protect_stamp = 0
         tail_block, tail_rows = None, 0
         if node.tail is not None:
             t_tokens, t_block, t_rows = node.tail
@@ -213,6 +275,13 @@ class RadixCache:
                 node.children[key] = child
                 self._nodes += 1
                 taken += 1
+            elif child.block is None:
+                # Host-resident page on the insert path (ISSUE 20): the
+                # caller just decoded through this page, so its device
+                # block carries the same KV — adopt it and free the host
+                # copy (a promotion that costs no onload).
+                self._adopt(child, blocks[i])
+                taken += 1
             child.last = stamp
             node = child
         rows = len(ids) % page
@@ -249,21 +318,40 @@ class RadixCache:
 
     # ---------------------------------------------------------- eviction
 
+    def _protected(self, node: _Node) -> bool:
+        """Is ``node`` on the match walk currently in flight? Promotion
+        can trigger eviction mid-walk (alloc_with_evict); the walk's own
+        path — every node stamped with the walk's clock value — must
+        survive it, since the caller's bulk incref happens only at the
+        end of the match."""
+        return self._protect_stamp > 0 and node.last >= self._protect_stamp
+
+    def _demotable(self, node: _Node) -> bool:
+        """May ``node`` give up its device block? Only once no descendant
+        chain still needs it: all children host-resident (or none), no
+        tail, not the walk-protected path. An interior eviction would
+        orphan device descendants' chains — but a node whose entire
+        subtree already lives in the host tier hangs at the bottom of the
+        device tree, so demoting/dropping it keeps both tiers coherent."""
+        return (node is not self._root and node.parent is not None
+                and node.tail is None and node.block is not None
+                and not self._protected(node)
+                and all(c.block is None for c in node.children.values()))
+
     def _evictables(self) -> List[Tuple[int, int, _Node]]:
         """(last, kind, node) for every droppable unit, LRU-first. Tails
         rank before their node's block (kind 0 < 1) so partial pages —
         the least shareable KV — reclaim first at equal recency; only
-        childless nodes may drop their block (an interior eviction would
-        orphan descendants' chains)."""
+        nodes passing ``_demotable`` may drop their block (an interior
+        eviction would orphan descendants' chains)."""
         out: List[Tuple[int, int, _Node]] = []
         stack = [self._root]
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
-            if node.tail is not None:
+            if node.tail is not None and not self._protected(node):
                 out.append((node.last, 0, node))
-            if node is not self._root and not node.children \
-                    and node.tail is None:
+            if self._demotable(node):
                 out.append((node.last, 1, node))
         out.sort(key=lambda t: (t[0], t[1]))
         return out
@@ -272,14 +360,24 @@ class RadixCache:
         del node.parent.children[node.key]
         self._nodes -= 1
         self._release(node.block)
+        if node.children:
+            # All host-resident (the _demotable precondition): dropping
+            # this interior node orphans its host subtree — purge it so
+            # the host store never holds unreachable pages.
+            for child in list(node.children.values()):
+                self._purge_host_subtree(child)
+            node.children = {}
 
     def _evict_until(self, done) -> bool:
         """Evict strictly-LRU units until ``done()``: one evictables
         collection seeds a heap, and dropping a node lazily pushes its
-        parent once it becomes childless — O((n + evictions)·log n),
+        parent once it becomes droppable — O((n + evictions)·log n),
         not the O(n²) a full re-collect per block would cost on the
         scheduler hot path, while preserving exact LRU order (a freed
         leaf's OLDER parent must evict before a younger sibling chain).
+        With a host store attached, "evict" means demote-to-host where
+        the page qualifies (cold, unmapped, store has or can make room)
+        and plain drop otherwise — either way the device block frees.
         Returns False once nothing evictable remains."""
         if done():
             return True
@@ -293,24 +391,23 @@ class RadixCache:
                 # Staleness: a unit may have been consumed by an earlier
                 # drop in this run (e.g. its tail went first).
                 if kind == 0:
-                    if node.tail is None:
+                    if node.tail is None or self._protected(node):
                         continue
                     self._drop_tail(node)
-                    if node is not self._root and not node.children:
-                        # The tail was the node's last droppable unit —
-                        # its block itself is evictable now.
+                    if self._demotable(node):
+                        # The tail was the node's last blocker — its
+                        # block itself is evictable now.
                         heapq.heappush(heap, (node.last, 1, seq, node))
                         seq += 1
                 else:
-                    if (node.children or node.tail is not None
-                            or node.parent is None
+                    if (not self._demotable(node)
                             or node.parent.children.get(node.key)
                             is not node):
                         continue
                     parent = node.parent
-                    self._drop_node(node)
-                    if (parent is not self._root and not parent.children
-                            and parent.tail is None):
+                    if not self._demote_node(node):
+                        self._drop_node(node)
+                    if self._demotable(parent):
                         heapq.heappush(heap,
                                        (parent.last, 1, seq, parent))
                         seq += 1
@@ -318,6 +415,169 @@ class RadixCache:
             else:
                 return False             # heap drained, target unmet
         return True
+
+    # ------------------------------------------------- host tier (ISSUE 20)
+
+    def _fault(self, name: str) -> bool:
+        """Consume a one-shot drill point off the duck-typed injector
+        view (offload_fail / onload_corrupt); False when no injector or
+        the point is not armed."""
+        fn = getattr(self.faults, name, None)
+        return bool(fn()) if callable(fn) else False
+
+    def _page_payload(self, node: _Node) -> np.ndarray:
+        """The bytes that travel to the host tier for one page: the
+        device KV rows when an offload_fn is wired (jax batcher), else
+        the page's token tuple (fake engine) — fictional KV, but a real
+        checksum round-trip either way."""
+        if self.offload_fn is not None:
+            return np.asarray(self.offload_fn(node.block))
+        return np.asarray(node.key, dtype=np.int64)
+
+    def _oldest_host_leaf(self, max_last: int) -> Optional[_Node]:
+        """LRU victim for host-store room-making: the stalest host leaf
+        no younger than ``max_last`` (the incoming demote's stamp — the
+        LRU spans both tiers, so a page colder than everything resident
+        is discarded rather than displacing warmer pages)."""
+        best: Optional[_Node] = None
+        for cand in self._host_nodes.values():
+            if cand.children or self._protected(cand):
+                continue
+            if cand.last > max_last:
+                continue
+            if best is None or cand.last < best.last:
+                best = cand
+        return best
+
+    def _drop_host_leaf(self, node: _Node) -> None:
+        del node.parent.children[node.key]
+        self._nodes -= 1
+        self.host_store.free(node.host)
+        self._host_nodes.pop(node.host, None)
+        self.host_store.note_dropped()
+        node.host = None
+
+    def _purge_host_subtree(self, node: _Node) -> None:
+        """Free every host page under (and including) ``node``, which is
+        already detached from its parent — used when an interior drop or
+        a corrupt onload invalidates the whole chain below a point."""
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            stack.extend(cur.children.values())
+            cur.children = {}
+            self._nodes -= 1
+            if cur.tail is not None:     # pragma: no cover - defensive
+                self._drop_tail(cur)
+                self._nodes += 1         # _drop_tail is not a node drop
+            if cur.host is not None:
+                self.host_store.free(cur.host)
+                self._host_nodes.pop(cur.host, None)
+                self.host_store.note_dropped()
+                cur.host = None
+            elif cur.block is not None:  # pragma: no cover - defensive
+                self._release(cur.block)
+
+    def _demote_node(self, node: _Node) -> bool:
+        """Device→host demotion of one cold page: copy the page payload
+        into the pinned host store (CRC32 stamped by ``put``), release
+        the device block, and keep the node in the tree host-resident.
+        Returns False when the page must be plain-dropped instead — host
+        tier off, the block still mapped by a live slot (demoting would
+        free no HBM), the ``offload:fail`` drill, or a store full of
+        strictly warmer pages."""
+        store = self.host_store
+        if store is None or self._demote_suspended:
+            return False
+        if self.pool.ref(node.block) != 1:
+            return False
+        if self._fault("offload_fail"):
+            store.offload_fail_total += 1
+            return False
+        while store.free_count < 1:
+            victim = self._oldest_host_leaf(node.last)
+            if victim is None:
+                store.note_dropped()
+                return False
+            self._drop_host_leaf(victim)
+        data = self._page_payload(node)
+        hbid = store.put(data)
+        node.host = hbid
+        self._host_nodes[hbid] = node
+        b = node.block
+        node.block = None
+        # The tree's device hold ends; ref==1 (checked above) means the
+        # block actually frees. Not an eviction for counting purposes —
+        # the page survives, demoted_total tracks it.
+        n = self._held.get(b, 0) - 1
+        if n <= 0:
+            self._held.pop(b, None)
+        else:                            # pragma: no cover - defensive
+            self._held[b] = n
+        self.pool.decref([b])
+        return True
+
+    def _promote(self, node: _Node) -> bool:
+        """Host→device promotion during a match walk: verify the page
+        against its demote-time checksum, allocate a device block (with
+        eviction backpressure — which may itself demote colder pages),
+        onload, and hand the alloc's ref to the tree. On a corrupt page
+        the node AND its host subtree drop (nothing below a bad page can
+        be trusted); on allocation failure the host copy is kept for a
+        later, less-pressured attempt. Either failure returns False —
+        the match ends there and the caller prefills the suffix."""
+        store = self.host_store
+        hbid = node.host
+        data = store.get(hbid)
+        if self._fault("onload_corrupt"):
+            # Flip one byte of a COPY of the payload: the real verify
+            # path catches it, exactly as bit-rot in host RAM would.
+            raw = bytearray(np.ascontiguousarray(data).tobytes())
+            if raw:
+                raw[0] ^= 0xFF
+            data = np.frombuffer(
+                bytes(raw), dtype=data.dtype).reshape(data.shape)
+        if not store.verify(hbid, data):
+            store.note_onload_fail("corrupt")
+            del node.parent.children[node.key]
+            self._purge_host_subtree(node)
+            return False
+        dev = alloc_with_evict(self.pool, self, 1)
+        if dev is None:
+            store.note_onload_fail("exhausted")
+            return False
+        b = dev[0]
+        if self.onload_fn is not None:
+            self.onload_fn(b, data)
+        store.free(hbid)
+        store.onloaded_total += 1
+        self._host_nodes.pop(hbid, None)
+        node.host = None
+        node.block = b
+        # alloc's refcount-1 becomes the tree's hold (no extra incref);
+        # the caller's ref rides the match's bulk incref like any other
+        # matched page.
+        self._held[b] = self._held.get(b, 0) + 1
+        return True
+
+    def _adopt(self, node: _Node, block: int) -> None:
+        """Insert-path promotion: the caller's device block already
+        carries this page's KV, so the host copy is redundant — take the
+        tree's own ref on the device block and free the host page."""
+        self.host_store.free(node.host)
+        self.host_store.adopted_total += 1
+        self._host_nodes.pop(node.host, None)
+        node.host = None
+        node.block = block
+        self._hold(block)
+
+    def host_holders(self) -> Dict[int, int]:
+        """Host-tier holder map for the cross-tier exact-balance check
+        (each resident host block is held by exactly one node)."""
+        return {hbid: 1 for hbid in self._host_nodes}
+
+    def host_resident_blocks(self) -> int:
+        return len(self._host_nodes)
 
     def enforce_budget(self) -> None:
         self._evict_until(lambda: len(self._held) <= self.max_blocks)
@@ -331,14 +591,27 @@ class RadixCache:
         return self._evict_until(lambda: self.pool.free_count >= n_free)
 
     def clear(self) -> None:
-        """Drop every cached block (engine reset: the pool's device
-        arrays are being rebuilt, so cached KV is invalid)."""
-        self._evict_until(lambda: not self._held and self._nodes == 0)
+        """Drop every cached block in BOTH tiers (engine reset: the
+        pool's device arrays are being rebuilt and host copies of a
+        possibly-poisoned generation cannot be trusted either, so the
+        containment reset rebuilds the whole two-tier world). Demotion
+        is suspended for the drain — clearing into the host store would
+        smuggle condemned KV across the reset."""
+        self._demote_suspended = True
+        try:
+            self._evict_until(lambda: not self._held and not self._nodes)
+        finally:
+            self._demote_suspended = False
+        if self.host_store is not None:
+            for hbid in list(self._host_nodes):
+                self.host_store.free(hbid)
+                self.host_store.note_dropped()
+        self._host_nodes.clear()
         self._root = _Node(None, None, None)
         self._nodes = 0
 
     def stats(self) -> dict:
-        return {
+        body = {
             "nodes": self.node_count(),
             "cached_blocks": len(self._held),
             "max_blocks": self.max_blocks,
@@ -347,6 +620,9 @@ class RadixCache:
             "insertions": self.insertions_total,
             "evicted_blocks": self.evicted_blocks_total,
         }
+        if self.host_store is not None:
+            body["host_resident_nodes"] = len(self._host_nodes)
+        return body
 
 
 def pages_needed(n_tokens: int, page: int) -> int:

@@ -24,6 +24,11 @@ Triggers (closed set — they are metric labels):
 - ``grammar_dead_end_spike`` — new grammar dead-end freezes
 - ``pool_exhausted``      — KV pool starvation truncated a slot
 - ``breaker_open``        — the service circuit breaker opened
+- ``host_tier_thrash``    — the two-tier KV pool is churning: pages
+                            demoted to host RAM AND onloaded back at
+                            matching rates since the last evaluation
+                            (the working set no longer fits the device
+                            tier — every admission pays tier traffic)
 
 Safety property: **capture can never cascade during the incident it is
 observing.** Each trigger has an independent cooldown
@@ -61,8 +66,10 @@ TRIGGER_QUARANTINE = "quarantine_spike"
 TRIGGER_GRAMMAR = "grammar_dead_end_spike"
 TRIGGER_POOL = "pool_exhausted"
 TRIGGER_BREAKER = "breaker_open"
+TRIGGER_HOST_THRASH = "host_tier_thrash"
 TRIGGERS = (TRIGGER_STEPTIME, TRIGGER_BURN, TRIGGER_QUARANTINE,
-            TRIGGER_GRAMMAR, TRIGGER_POOL, TRIGGER_BREAKER)
+            TRIGGER_GRAMMAR, TRIGGER_POOL, TRIGGER_BREAKER,
+            TRIGGER_HOST_THRASH)
 
 # ---------------------------------------------------------------------------
 # Log-join stamp: the active incident window, readable by the log filter
@@ -117,10 +124,16 @@ class IncidentManager:
 
     def __init__(self, *, ring: int = 8, cooldown_secs: float = 60.0,
                  burn_threshold: float = 2.0,
+                 thrash_min_blocks: int = 8,
                  stamp_secs: Optional[float] = None):
         self.ring_size = max(1, int(ring))
         self.cooldown_secs = max(0.0, float(cooldown_secs))
         self.burn_threshold = max(0.0, float(burn_threshold))
+        # host_tier_thrash sensitivity: BOTH the demote and onload
+        # deltas since the last evaluation must reach this many blocks
+        # (0 disables). Churn is the conjunction — a one-way flow is
+        # just warmup or drain, not thrash.
+        self.thrash_min_blocks = max(0, int(thrash_min_blocks))
         # How long log lines keep joining a fresh bundle; defaults to
         # the cooldown (the window in which no NEW bundle can appear).
         self.stamp_secs = (self.cooldown_secs if stamp_secs is None
@@ -181,6 +194,19 @@ class IncidentManager:
                 out.append((TRIGGER_POOL, {
                     "new_starved_slots": n,
                     "free_blocks": kv.get("free")}))
+            host = kv.get("host_tier") or {}
+            dn = self._spike("host_demoted",
+                             int(host.get("demoted_total", 0) or 0))
+            on = self._spike("host_onloaded",
+                             int(host.get("onloaded_total", 0) or 0))
+            if (self.thrash_min_blocks > 0
+                    and min(dn, on) >= self.thrash_min_blocks):
+                out.append((TRIGGER_HOST_THRASH, {
+                    "demoted_delta": dn,
+                    "onloaded_delta": on,
+                    "host_used": host.get("used"),
+                    "host_capacity": host.get("capacity"),
+                    "threshold": self.thrash_min_blocks}))
             breaker = views.get("breaker")
             prev = self._last_totals.get("breaker")
             self._last_totals["breaker"] = breaker

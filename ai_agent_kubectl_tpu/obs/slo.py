@@ -43,6 +43,11 @@ from typing import Dict, List, Optional, Tuple
 #: cardinality is bounded by construction, like lanes and ledger classes).
 SLO_TTFT = "ttft"
 SLO_QUEUE_WAIT = "queue_wait"
+#: turn-N TTFT for returning sessions (ISSUE 20): judged ONLY for
+#: radix-warm re-admissions — the samples price what the two-tier KV
+#: cache is for (a returning agent turn must not pay a cold re-prefill),
+#: so cold first turns never dilute the burn rate.
+SLO_SESSION_TTFT = "session_ttft"
 
 #: validation cap on configured windows — each window is a label value
 #: on every slo_* gauge, so the operator knob must not mint unbounded

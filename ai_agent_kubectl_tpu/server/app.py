@@ -149,7 +149,8 @@ class Service:
         self.incidents = IncidentManager(
             ring=cfg.incident_ring,
             cooldown_secs=cfg.incident_cooldown_secs,
-            burn_threshold=cfg.incident_burn_threshold)
+            burn_threshold=cfg.incident_burn_threshold,
+            thrash_min_blocks=cfg.incident_thrash_min_blocks)
         self.config_fingerprint = hashlib.sha256(
             json.dumps(cfg.describe(), sort_keys=True,
                        default=repr).encode()).hexdigest()[:12]
@@ -632,6 +633,10 @@ async def qos_middleware(request: web.Request, handler):
         request.headers.get("X-Priority"),
         svc.tenant_tiers,
         svc.cfg.qos_default_lane,
+        # Session identity (ISSUE 20): client-declared, namespaced under
+        # the tenant by classify so sessions can't collide (or spend
+        # each other's budget) across tenants.
+        session=request.headers.get("X-Session-ID"),
     )
     trace = current_trace()
     if trace is not None:
@@ -826,7 +831,11 @@ async def handle_kubectl_command(request: web.Request) -> web.Response:
             from_cache=from_cache,
             metadata=ExecutionMetadata(**build_metadata(start_iso, t0, True)),
             engine_metadata=engine_md,
-            degraded=degraded,
+            # Degraded is rule-table fallback OR an engine-side
+            # starvation truncation (ISSUE 20) — either way the client
+            # must not take the answer as full-fidelity.
+            degraded=degraded or (engine_result is not None
+                                  and engine_result.degraded),
             timings=timings,
         )
         payload = body.model_dump()

@@ -113,7 +113,7 @@ def build_engine(cfg: ServiceConfig) -> Engine:
         # but it is a test harness, not a factory-selectable ENGINE.)
         needs_batcher = [p for p in ("admit", "chunk", "decode", "scheduler",
                                      "tenant", "draft", "swap",
-                                     "checkpoint")
+                                     "checkpoint", "offload", "onload")
                          if injector.has_any(p)]
         batched = cfg.engine in ("jax", "jax-batched") and (
             cfg.engine == "jax-batched" or cfg.decode_batch_size > 1)

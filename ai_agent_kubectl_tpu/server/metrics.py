@@ -192,7 +192,43 @@ class Metrics:
             "them",
             registry=r,
         )
-        self._kv_pool_seen = {"shared": 0, "cow": 0, "hit": 0, "miss": 0}
+        # Two-tier KV (ISSUE 20): host-RAM tier occupancy + demote/
+        # onload flow. ``cause`` on the onload-fail counter is the
+        # closed HostBlockStore.ONLOAD_FAIL_CAUSES set (corrupt |
+        # exhausted) — cardinality bounded by construction.
+        self.kv_host_blocks = Gauge(
+            "kv_host_blocks",
+            "Host-tier KV blocks by state (used | free)",
+            ["state"],
+            registry=r,
+        )
+        self.kv_blocks_demoted = Counter(
+            "kv_blocks_demoted_total",
+            "KV blocks demoted from HBM to the host-RAM tier",
+            registry=r,
+        )
+        self.kv_blocks_onloaded = Counter(
+            "kv_blocks_onloaded_total",
+            "Host-tier KV blocks re-onloaded to HBM (checksum verified)",
+            registry=r,
+        )
+        self.kv_onload_fail = Counter(
+            "kv_onload_fail_total",
+            "Host-tier onload failures by cause (corrupt = checksum "
+            "mismatch, chain dropped + prefill fallback; exhausted = "
+            "no device block free)",
+            ["cause"],
+            registry=r,
+        )
+        self.kv_host_dropped = Counter(
+            "kv_host_blocks_dropped_total",
+            "Host-tier blocks discarded (LRU displacement, corrupt-"
+            "chain purge, or reset drain)",
+            registry=r,
+        )
+        self._kv_pool_seen = {"shared": 0, "cow": 0, "hit": 0, "miss": 0,
+                              "demoted": 0, "onloaded": 0, "dropped": 0,
+                              "fail_corrupt": 0, "fail_exhausted": 0}
 
         # Tensor-parallel serving (ISSUE 14, parallel/sharding.py):
         # the active mesh size, the residual TP fraction the f≈1 policy
@@ -697,6 +733,31 @@ class Metrics:
             if total > seen[key]:
                 counter.inc(total - seen[key])
                 seen[key] = total
+        # Two-tier host tier (ISSUE 20): absent when HOST_KV_BLOCKS=0 —
+        # the gauges/counters simply never move.
+        host = pool.get("host_tier")
+        if host:
+            self.kv_host_blocks.labels(state="used").set(
+                host.get("used", 0))
+            self.kv_host_blocks.labels(state="free").set(
+                host.get("free", 0))
+            fails = host.get("onload_fail_total") or {}
+            for key, counter, total in (
+                    ("demoted", self.kv_blocks_demoted,
+                     host.get("demoted_total", 0)),
+                    ("onloaded", self.kv_blocks_onloaded,
+                     host.get("onloaded_total", 0)),
+                    ("dropped", self.kv_host_dropped,
+                     host.get("dropped_total", 0)),
+                    ("fail_corrupt",
+                     self.kv_onload_fail.labels(cause="corrupt"),
+                     fails.get("corrupt", 0)),
+                    ("fail_exhausted",
+                     self.kv_onload_fail.labels(cause="exhausted"),
+                     fails.get("exhausted", 0))):
+                if total > seen[key]:
+                    counter.inc(total - seen[key])
+                    seen[key] = total
 
     def observe_sharding(self, sharding: dict) -> None:
         """Mirror the engine's sharding view (stats()["sharding"],

@@ -34,6 +34,14 @@ fault points that the engine layer checks at its seams:
 - ``checkpoint`` — ``checkpoint:corrupt`` fails the next checkpoint
   LOAD's integrity validation (ISSUE 13): one-shot; the swap is atomic
   so the prior weights stay armed and the rollout rolls back onto them
+- ``offload`` — ``offload:fail`` kills the next KV-block demotion to
+  the host tier (ISSUE 20): one-shot, checked inside the radix demote
+  path — the page falls back to a plain discard, so device-tier
+  behaviour must stay identical to ``HOST_KV_BLOCKS=0``
+- ``onload`` — ``onload:corrupt`` corrupts the next host-tier page
+  fetched for promotion (ISSUE 20): one-shot; the demote-time checksum
+  must catch it, the chain drops, and the request completes
+  byte-identically via ordinary suffix prefill — zero failed requests
 - ``generate`` — the whole engine call (applied by ``ChaosEngine``, the
   protocol wrapper the factory installs when FAULT_POINTS names it)
 
@@ -47,9 +55,10 @@ Modes (the third ``:``-field is mode-specific):
 - ``nan[:rate]`` — (``decode`` only) corrupt one slot's logits
 - ``poison_step[:rate]`` — (``decode`` only) raise from the chunk fetch
 - ``die`` — (``scheduler`` only) kill the scheduler loop, one-shot
-- ``fail`` — (``swap`` only) die mid-weight-swap, one-shot
-- ``corrupt`` — (``checkpoint`` only) fail checkpoint load validation,
-  one-shot
+- ``fail`` — (``swap``/``offload``) die mid-weight-swap / fail the next
+  host-tier demotion, one-shot
+- ``corrupt`` — (``checkpoint``/``onload``) fail checkpoint load
+  validation / corrupt the next host-tier page promotion, one-shot
 
 Targeting: by default ``decode`` faults pick the first live slot. Tests
 that need the fault to FOLLOW one request across resets/replays set
@@ -82,17 +91,20 @@ _MODES = ("error", "delay", "hang", "nan", "poison_step", "die", "flood",
 #: the closed set of check sites; a typo'd point in FAULT_POINTS must be
 #: a startup error, not a silently inert game-day drill.
 KNOWN_POINTS = ("admit", "chunk", "decode", "scheduler", "tenant",
-                "draft", "swap", "checkpoint", "generate")
+                "draft", "swap", "checkpoint", "offload", "onload",
+                "generate")
 
 #: (point, mode) pairs that only make sense together — a drill spec
 #: arming e.g. ``admit:nan`` is a typo, not chaos.
 _POINT_ONLY_MODES = {"nan": ("decode",), "poison_step": ("decode",),
                      "die": ("scheduler", "draft"), "flood": ("tenant",),
-                     "fail": ("swap",), "corrupt": ("checkpoint",)}
+                     "fail": ("swap", "offload"),
+                     "corrupt": ("checkpoint", "onload")}
 _RESTRICTED_POINTS = {"decode": ("nan", "poison_step"),
                       "scheduler": ("die",), "tenant": ("flood",),
                       "draft": ("die",), "swap": ("fail",),
-                      "checkpoint": ("corrupt",)}
+                      "checkpoint": ("corrupt",),
+                      "offload": ("fail",), "onload": ("corrupt",)}
 
 #: tenant key + lane the flood drill's synthetic burst runs under —
 #: fixed so fairness assertions and dashboards can name the flooder.
@@ -436,6 +448,22 @@ class FaultInjector:
         and the rollout rolls back with the prior weights restored."""
         return self._one_shot("checkpoint", "corrupt", replica)
 
+    def offload_fail(self, replica: Optional[int] = None) -> bool:
+        """``offload:fail`` — one-shot (ISSUE 20): the next KV-page
+        demotion to the host tier through an armed engine fails, and the
+        radix eviction falls back to the plain discard it always did —
+        what's under test is that a broken host tier degrades to exactly
+        the ``HOST_KV_BLOCKS=0`` device-tier behaviour, never an error."""
+        return self._one_shot("offload", "fail", replica)
+
+    def onload_corrupt(self, replica: Optional[int] = None) -> bool:
+        """``onload:corrupt`` — one-shot (ISSUE 20): the next host-tier
+        page fetched for promotion reads back corrupted. The demote-time
+        CRC32 must catch it, the tainted host subtree drops, and the
+        request completes byte-identically via ordinary suffix prefill
+        with the books still balanced across both tiers."""
+        return self._one_shot("onload", "corrupt", replica)
+
     def check_scheduler_die(self, replica: Optional[int] = None) -> None:
         """``scheduler:die`` — one-shot: raises ``SchedulerKilled`` (a
         BaseException) so the scheduler loop genuinely dies; disarms
@@ -514,6 +542,12 @@ class ReplicaFaults:
 
     def checkpoint_corrupt(self) -> bool:
         return self.inner.checkpoint_corrupt(replica=self.replica)
+
+    def offload_fail(self) -> bool:
+        return self.inner.offload_fail(replica=self.replica)
+
+    def onload_corrupt(self) -> bool:
+        return self.inner.onload_corrupt(replica=self.replica)
 
     def tenant_flood(self) -> int:
         return self.inner.tenant_flood(replica=self.replica)

@@ -925,6 +925,100 @@ async def phase_paged7b(batch_size: int, max_seq: int, kv_quant: str,
     return out
 
 
+async def phase_agent7b(batch_size: int, max_seq: int, kv_quant: str,
+                        host_kv_blocks: int,
+                        chunk_len: int = 16) -> dict:
+    """One rung of the ISSUE 20 two-tier sweep: 8 concurrent 3-turn
+    agent sessions re-sending their whole history each turn, on a pool
+    sized to exactly the live slots' working set — the device tier
+    CANNOT keep every session's chain cached between turns, so cold
+    chains must leave it. With ``host_kv_blocks=0`` they are dropped and
+    turn N pays a full re-prefill; with the host tier on they demote to
+    pinned host RAM and onload back when the session returns. Per-turn
+    TTFT medians are the artifact (``ttft_turn{1,2,3}_ms`` — the turn-N
+    entries are the number the session SLO prices), alongside the
+    demote/onload totals that prove which path served the turns."""
+    import jax
+
+    from ai_agent_kubectl_tpu.engine.batcher import BatchedJaxEngine
+    from ai_agent_kubectl_tpu.models.config import get_config
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "not on TPU"}
+
+    cfg7 = get_config("gemma-7b-it")
+    tok7, _ = make_tokenizer(cfg7)
+    page = 64
+    # Exactly the live working set (bs full-length chains): any cached
+    # chain beyond the decoding slots must evict, which is the point —
+    # eviction is what the host tier turns from a drop into a demote.
+    pool_blocks = batch_size * (-(-(max_seq + chunk_len) // page))
+    log(f"bench: agent7b rung bs={batch_size} blocks={pool_blocks} "
+        f"host_kv_blocks={host_kv_blocks}")
+    eng = BatchedJaxEngine(
+        cfg7,
+        tokenizer=tok7,
+        dtype="bfloat16",
+        quant="int8",
+        kv_quant=kv_quant,
+        max_seq_len=max_seq,
+        prefill_buckets=(64, 128),
+        batch_size=batch_size,
+        chunk_len=chunk_len,
+        kv_pool=True,
+        kv_pool_page=page,
+        kv_pool_blocks=pool_blocks,
+        host_kv_blocks=host_kv_blocks,
+    )
+    t0 = time.monotonic()
+    await eng.start()
+    log(f"bench: agent7b engine ready in {time.monotonic() - t0:.1f}s")
+
+    from ai_agent_kubectl_tpu.engine.prompts import render_prompt
+
+    turn_ttfts: list = [[], [], []]
+
+    async def session(i: int) -> None:
+        history = render_prompt(f"describe deployment web-{i}")
+        for turn in range(3):
+            t0 = time.monotonic()
+            first = None
+            text = []
+            async for piece in eng.generate_stream(
+                    history, max_tokens=48, temperature=0.0):
+                if first is None:
+                    first = time.monotonic() - t0
+                text.append(piece)
+            turn_ttfts[turn].append((first or 0.0) * 1000.0)
+            history = history + "".join(text) + f"\nand turn {turn + 2}?"
+
+    await asyncio.gather(*[session(i) for i in range(8)])
+    pool_stats = eng.stats().get("kv_pool") or {}
+    radix = pool_stats.get("radix") or {}
+    host = pool_stats.get("host_tier") or {}
+    await eng.stop()
+    out = {
+        "model": "gemma-7b-it",
+        "batch_size": batch_size,
+        "max_seq_len": max_seq,
+        "kv_quant": kv_quant,
+        "kv_pool_blocks": pool_blocks,
+        "host_kv_blocks": host_kv_blocks,
+        "radix_hit_tokens": radix.get("hit_tokens", 0),
+        "radix_miss_tokens": radix.get("miss_tokens", 0),
+        "host_demoted": host.get("demoted_total", 0),
+        "host_onloaded": host.get("onloaded_total", 0),
+    }
+    demoted = out["host_demoted"]
+    if demoted:
+        out["onload_hit_rate"] = round(out["host_onloaded"] / demoted, 4)
+    for turn, samples in enumerate(turn_ttfts, start=1):
+        if samples:
+            out[f"ttft_turn{turn}_ms"] = round(
+                statistics.median(samples), 2)
+    return out
+
+
 async def phase_ragged7b(batch_size: int, max_seq: int, kv_quant: str,
                          ragged: bool, spec_k: int = 4,
                          chunk_len: int = 16) -> dict:
@@ -1351,6 +1445,30 @@ def orchestrate() -> dict:
         if kv_sweep["pool"] or kv_sweep["dense"]:
             extra7["kv_pool_sweep"] = kv_sweep
 
+        # Two-tier host offload sweep (ISSUE 20): the 8x3-turn agent
+        # loop on a pool sized to force eviction, host tier off (cold
+        # chains drop, returning turns re-prefill) vs on (chains demote
+        # to host RAM and onload back). Turn-N TTFT is the headline —
+        # the number the session SLO prices.
+        agent_keys = ("ttft_turn1_ms", "ttft_turn2_ms", "ttft_turn3_ms",
+                      "host_demoted", "host_onloaded", "onload_hit_rate",
+                      "radix_hit_tokens", "kv_pool_blocks",
+                      "host_kv_blocks")
+        agent_sweep: dict = {}
+        for mode, blocks in (("host_off", 0), ("host_on", 2048)):
+            ra = _run_phase(
+                ["--phase", "agent7b", "--bs", "8",
+                 "--max-seq", str(extra7["max_seq_len"]),
+                 "--kv-quant", extra7["kv_quant"],
+                 "--host-kv-blocks", str(blocks)],
+                timeout=1800)
+            if _ok(ra):
+                agent_sweep[mode] = {k: ra.get(k) for k in agent_keys}
+            elif isinstance(ra, dict) and "status" in ra:
+                agent_sweep[mode] = ra
+        if agent_sweep:
+            extra7["agent_sweep"] = agent_sweep
+
         # Grammar-constrained decode sweep (ISSUE 11): the kubectl
         # query set with the grammar off vs on at the bs=48 rung —
         # decode-steps-per-command is the headline (forced runs ride
@@ -1544,7 +1662,7 @@ def orchestrate() -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", choices=["7b", "2b", "moe", "attr7b",
-                                        "pipe7b", "paged7b",
+                                        "pipe7b", "paged7b", "agent7b",
                                         "grammar7b", "spec7b", "tp7b",
                                         "tp_spec7b", "ragged7b"],
                     default=None)
@@ -1556,6 +1674,7 @@ def main() -> None:
     ap.add_argument("--kv-pool", choices=["on", "off"], default="on")
     ap.add_argument("--pool-envelope-bs", type=int, default=0)
     ap.add_argument("--agent-loop", action="store_true")
+    ap.add_argument("--host-kv-blocks", type=int, default=0)
     ap.add_argument("--grammar", choices=["on", "off"], default="off")
     ap.add_argument("--spec", choices=["on", "off"], default="off")
     ap.add_argument("--spec-k", type=int, default=4)
@@ -1572,6 +1691,10 @@ def main() -> None:
             phase_paged7b(ns.bs, ns.max_seq, ns.kv_quant,
                           ns.kv_pool == "on", ns.pool_envelope_bs,
                           ns.agent_loop, ns.chunk_len))
+    elif ns.phase == "agent7b":
+        result = asyncio.run(
+            phase_agent7b(ns.bs, ns.max_seq, ns.kv_quant,
+                          ns.host_kv_blocks, ns.chunk_len))
     elif ns.phase == "pipe7b":
         result = asyncio.run(
             phase_pipe7b(ns.bs, ns.max_seq, ns.kv_quant, ns.pipe_depth,

@@ -107,6 +107,19 @@ METRICS: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = (
     ("gemma_7b.ragged.bs192.programs", "steptime",
      ("extra", "gemma_7b", "ragged_sweep", "bs192_ragged",
       "compiled_programs")),
+    # Two-tier agent sweep (ISSUE 20): turn-N TTFT of returning
+    # sessions on an eviction-forcing pool, host tier off vs on.
+    # Required once a trajectory artifact records them — a host-on rung
+    # whose turn-3 TTFT regresses toward the host-off (full re-prefill)
+    # number means the onload path stopped serving returning turns, and
+    # a vanished agent7b phase fails as absent/timed_out, never as a
+    # silent pass.
+    ("gemma_7b.agent.host_on.ttft_turn2_ms", "latency",
+     ("extra", "gemma_7b", "agent_sweep", "host_on", "ttft_turn2_ms")),
+    ("gemma_7b.agent.host_on.ttft_turn3_ms", "latency",
+     ("extra", "gemma_7b", "agent_sweep", "host_on", "ttft_turn3_ms")),
+    ("gemma_7b.agent.host_off.ttft_turn3_ms", "latency",
+     ("extra", "gemma_7b", "agent_sweep", "host_off", "ttft_turn3_ms")),
 )
 
 

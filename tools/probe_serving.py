@@ -247,6 +247,21 @@ def print_kv_pool_summary(gauges: Dict[str, float]) -> None:
     log(f"  radix miss tokens total     {miss:>8.0f}")
     if hit + miss:
         log(f"  radix hit rate              {hit / (hit + miss):>8.1%}")
+    # Two-tier host offload (ISSUE 20): occupancy of the host-RAM block
+    # store and how often a demoted chain came back (onloads / demotes).
+    host = _sum_labelled(gauges, "kv_host_blocks")
+    if host:
+        h_total = sum(host.values())
+        h_used = host.get('state="used"', 0.0)
+        log(f"  host tier blocks total      {h_total:>8.0f}")
+        if h_total:
+            log(f"  host tier occupancy         {h_used / h_total:>8.1%}")
+        demoted = gauges.get("kv_blocks_demoted_total", 0.0)
+        onloaded = gauges.get("kv_blocks_onloaded_total", 0.0)
+        log(f"  blocks demoted total        {demoted:>8.0f}")
+        log(f"  blocks onloaded total       {onloaded:>8.0f}")
+        if demoted:
+            log(f"  onload hit rate             {onloaded / demoted:>8.1%}")
 
 
 def print_grammar_summary(gauges: Dict[str, float]) -> None:
